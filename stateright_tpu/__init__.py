@@ -1,0 +1,56 @@
+"""stateright_tpu: a TPU-native explicit-state model checker for distributed systems.
+
+A brand-new framework with the capabilities of the Rust `stateright` library
+(reference: /root/reference): an explicit-state model checker (BFS / DFS /
+on-demand / simulation engines) for user-defined transition systems with
+always / sometimes / eventually properties, an actor framework whose systems
+can be both exhaustively checked and executed on a real network,
+linearizability and sequential-consistency testers, symmetry reduction, and an
+interactive state-space explorer.
+
+The core exploration loop is re-designed TPU-first: successor generation and
+property evaluation are batched `vmap`-style kernels over fixed-width uint32
+state encodings, the visited set is an open-addressing hash table living in
+device memory, and multi-chip scale comes from sharding the frontier over a
+`jax.sharding.Mesh` with XLA collectives (see `stateright_tpu.parallel`).
+
+Public API parity map (reference file:line cited in each module's docstring):
+  - Model / Property / Expectation   <-> src/lib.rs:158-338
+  - CheckerBuilder / Checker         <-> src/checker.rs:65-578
+  - BFS / DFS / simulation / on-demand engines <-> src/checker/{bfs,dfs,simulation,on_demand}.rs
+  - Path                             <-> src/checker/path.rs
+  - actor framework                  <-> src/actor.rs, src/actor/*
+  - semantics (linearizability etc.) <-> src/semantics*
+"""
+
+from .core import Expectation, Model, Property, fingerprint
+from .checker import Checker, CheckerBuilder, DiscoveryClassification
+from .has_discoveries import HasDiscoveries
+from .path import Path
+from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
+from .visitor import CheckerVisitor, PathRecorder, StateRecorder
+from .tensor import TensorModel, TensorModelAdapter, TensorProperty
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "DiscoveryClassification",
+    "Expectation",
+    "HasDiscoveries",
+    "Model",
+    "Path",
+    "PathRecorder",
+    "Property",
+    "ReportData",
+    "ReportDiscovery",
+    "Reporter",
+    "StateRecorder",
+    "TensorModel",
+    "TensorModelAdapter",
+    "TensorProperty",
+    "WriteReporter",
+    "fingerprint",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,292 @@
+"""CheckerBuilder / Checker: configure, launch, and query checking runs.
+
+Reference: src/checker.rs:65-578. The builder carries the model plus options
+(threads, symmetry, target_state_count, target_max_depth, finish_when,
+timeout, visitor) and spawns one of the engines:
+
+  - `spawn_bfs()`        host breadth-first search (engines/bfs.py)
+  - `spawn_dfs()`        host depth-first search (engines/dfs.py)
+  - `spawn_on_demand()`  lazy BFS for the Explorer (engines/on_demand.py)
+  - `spawn_simulation()` seeded random walks (engines/simulation.py)
+  - `spawn_tpu_bfs()`    the TPU-native batched frontier engine
+                         (engines/tpu_bfs.py) — new in this framework
+  - `serve()`            Explorer web service over an on-demand checker
+
+`Checker` exposes state_count / unique_state_count / max_depth / discoveries
+and assertion helpers, matching checker.rs:294-578.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .core import Expectation, Model
+from .has_discoveries import HasDiscoveries
+from .path import Path
+from .report import ReportData, ReportDiscovery, Reporter
+from .visitor import CheckerVisitor, as_visitor
+
+
+class DiscoveryClassification:
+    """Reference: checker.rs:39-53."""
+
+    EXAMPLE = "example"
+    COUNTEREXAMPLE = "counterexample"
+
+
+class CheckerBuilder:
+    """Fluent options builder. Reference: checker.rs:65-288."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.symmetry_fn_: Optional[Any] = None
+        self.target_state_count_: Optional[int] = None
+        self.target_max_depth_: Optional[int] = None
+        self.thread_count_: int = 1
+        self.visitor_: Optional[CheckerVisitor] = None
+        self.finish_when_: HasDiscoveries = HasDiscoveries.ALL
+        self.timeout_: Optional[float] = None
+
+    # -- options ------------------------------------------------------------
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enable symmetry reduction via the state's `representative()` method.
+
+        Reference: checker.rs:219-227.
+        """
+        return self.symmetry_fn(lambda state: state.representative())
+
+    def symmetry_fn(self, representative) -> "CheckerBuilder":
+        self.symmetry_fn_ = representative
+        return self
+
+    def finish_when(self, has_discoveries: HasDiscoveries) -> "CheckerBuilder":
+        self.finish_when_ = has_discoveries
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        self.target_state_count_ = count if count > 0 else None
+        return self
+
+    def target_max_depth(self, depth: int) -> "CheckerBuilder":
+        self.target_max_depth_ = depth if depth > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        self.thread_count_ = thread_count
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        self.visitor_ = as_visitor(visitor)
+        return self
+
+    def timeout(self, seconds: float) -> "CheckerBuilder":
+        self.timeout_ = seconds
+        return self
+
+    # -- engines ------------------------------------------------------------
+
+    def spawn_bfs(self) -> "Checker":
+        from .engines.bfs import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self) -> "Checker":
+        from .engines.dfs import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_on_demand(self) -> "Checker":
+        from .engines.on_demand import OnDemandChecker
+
+        return OnDemandChecker(self)
+
+    def spawn_simulation(self, seed: int, chooser=None) -> "Checker":
+        from .engines.simulation import SimulationChecker, UniformChooser
+
+        return SimulationChecker(self, seed, chooser or UniformChooser())
+
+    def spawn_tpu_bfs(self, **kw) -> "Checker":
+        """The TPU-native batched BFS engine over a TensorModel."""
+        from .engines.tpu_bfs import TpuBfsChecker
+
+        return TpuBfsChecker(self, **kw)
+
+    def serve(self, address: str):
+        """Start the Explorer web service. Reference: checker.rs:144-151."""
+        from .explorer.server import serve
+
+        return serve(self, address)
+
+
+class Checker:
+    """Query interface over a (possibly still-running) checking run.
+
+    Reference: the `Checker` trait, checker.rs:294-578.
+    """
+
+    # Engines must set: _model, and implement the count/discovery accessors.
+
+    def model(self) -> Model:
+        return self._model  # type: ignore[attr-defined]
+
+    # -- to be implemented by engines ---------------------------------------
+
+    def state_count(self) -> int:
+        raise NotImplementedError
+
+    def unique_state_count(self) -> int:
+        raise NotImplementedError
+
+    def max_depth(self) -> int:
+        raise NotImplementedError
+
+    def discoveries(self) -> Dict[str, Path]:
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def join(self) -> "Checker":
+        return self
+
+    # -- on-demand engine hooks (no-ops elsewhere; checker.rs:298-306) ------
+
+    def check_fingerprint(self, fingerprint: int) -> None:
+        pass
+
+    def run_to_completion(self) -> None:
+        pass
+
+    # -- derived helpers ----------------------------------------------------
+
+    def discovery(self, name: str) -> Optional[Path]:
+        return self.discoveries().get(name)
+
+    def discovery_classification(self, name: str) -> str:
+        """Reference: checker.rs:455-464."""
+        prop = self.model().property(name)
+        if prop.expectation in (Expectation.ALWAYS, Expectation.EVENTUALLY):
+            return DiscoveryClassification.COUNTEREXAMPLE
+        return DiscoveryClassification.EXAMPLE
+
+    def report(self, reporter: Reporter) -> "Checker":
+        """Poll progress until done, then emit a discovery summary.
+
+        Reference: checker.rs:412-452.
+        """
+        start = time.monotonic()
+        snap = getattr(self, "_initial_snapshot", None)
+        if snap is not None:
+            reporter.report_checking(
+                ReportData(
+                    total_states=snap[0],
+                    unique_states=snap[1],
+                    max_depth=snap[2],
+                    duration_secs=0.0,
+                    done=False,
+                )
+            )
+        while not self.is_done():
+            reporter.report_checking(
+                ReportData(
+                    total_states=self.state_count(),
+                    unique_states=self.unique_state_count(),
+                    max_depth=self.max_depth(),
+                    duration_secs=time.monotonic() - start,
+                    done=False,
+                )
+            )
+            time.sleep(reporter.delay())
+        self.join()
+        reporter.report_checking(
+            ReportData(
+                total_states=self.state_count(),
+                unique_states=self.unique_state_count(),
+                max_depth=self.max_depth(),
+                duration_secs=time.monotonic() - start,
+                done=True,
+            )
+        )
+        discoveries = {
+            name: ReportDiscovery(path, self.discovery_classification(name))
+            for name, path in self.discoveries().items()
+        }
+        reporter.report_discoveries(self.model(), discoveries)
+        return self
+
+    def join_and_report(self, reporter: Reporter) -> "Checker":
+        return self.report(reporter)
+
+    # -- assertion helpers (checker.rs:466-577) -----------------------------
+
+    def assert_properties(self) -> None:
+        for p in self.model().properties():
+            if p.expectation in (Expectation.ALWAYS, Expectation.EVENTUALLY):
+                self.assert_no_discovery(p.name)
+            else:
+                self.assert_any_discovery(p.name)
+
+    def assert_any_discovery(self, name: str) -> Path:
+        found = self.discovery(name)
+        if found is not None:
+            return found
+        if not self.is_done():
+            raise AssertionError(
+                f'Discovery for "{name}" not found, but model checking is incomplete.'
+            )
+        raise AssertionError(f'Discovery for "{name}" not found.')
+
+    def assert_no_discovery(self, name: str) -> None:
+        found = self.discovery(name)
+        if found is not None:
+            raise AssertionError(
+                f'Unexpected "{name}" {self.discovery_classification(name)} '
+                f"{found}Last state: {found.last_state()!r}\n"
+            )
+        if not self.is_done():
+            raise AssertionError(
+                f'Discovery for "{name}" not found, but model checking is incomplete.'
+            )
+
+    def assert_discovery(self, name: str, actions: List[Any]) -> None:
+        """Assert `actions` forms a valid discovery for property `name`.
+
+        Reference: checker.rs:519-577.
+        """
+        additional_info: List[str] = []
+        found = self.assert_any_discovery(name)
+        model = self.model()
+        for init_state in model.init_states():
+            path = Path.from_actions(model, init_state, actions)
+            if path is None:
+                continue
+            prop = model.property(name)
+            if prop.expectation == Expectation.ALWAYS:
+                if not prop.condition(model, path.last_state()):
+                    return
+            elif prop.expectation == Expectation.EVENTUALLY:
+                states = path.into_states()
+                is_liveness_satisfied = any(
+                    prop.condition(model, s) for s in states
+                )
+                last_actions: List[Any] = []
+                model.actions(states[-1], last_actions)
+                is_path_terminal = not last_actions
+                if not is_liveness_satisfied and is_path_terminal:
+                    return
+                if is_liveness_satisfied:
+                    additional_info.append(
+                        "incorrect counterexample satisfies eventually property"
+                    )
+                if not is_path_terminal:
+                    additional_info.append("incorrect counterexample is nonterminal")
+            else:  # SOMETIMES
+                if prop.condition(model, path.last_state()):
+                    return
+        extra = f" ({'; '.join(additional_info)})" if additional_info else ""
+        raise AssertionError(
+            f'Invalid discovery for "{name}"{extra}, but a valid one was found. '
+            f"found={found.into_actions()!r}"
+        )

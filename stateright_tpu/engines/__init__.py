@@ -1,0 +1,9 @@
+"""Checking engines.
+
+Host engines (bfs, dfs, simulation, on_demand) mirror the reference's
+src/checker/{bfs,dfs,simulation,on_demand}.rs semantics exactly — same queue
+discipline, counters, eventually-bit propagation, and early-exit rules — so
+golden state counts and visit orders are reproducible. The TPU engine
+(tpu_bfs) is the new data-parallel design: a batched frontier over fixed-width
+state encodings with a device-resident visited set.
+"""

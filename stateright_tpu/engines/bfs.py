@@ -1,0 +1,126 @@
+"""Host breadth-first search engine.
+
+Reference: src/checker/bfs.rs. Exhaustive BFS with parent-pointer path
+reconstruction: the visited map stores fingerprint -> parent fingerprint
+(None for initial states), and discoveries are reconstructed by walking the
+parent chain and re-executing the model along it (bfs.rs:380-409, the TLC
+technique). Queue discipline matches the reference exactly — jobs pop from
+the back, successors push to the front (FIFO) — so visit-order goldens and
+early-exit state counts are reproducible.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Optional
+
+from ..checker import CheckerBuilder
+from ..path import Path
+from .common import BLOCK_SIZE, HostEngineBase
+
+
+class BfsChecker(HostEngineBase):
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        model = self._model
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        # visited: fingerprint -> Optional[parent fingerprint] (bfs.rs:29-30)
+        self._generated: Dict[int, Optional[int]] = {}
+        for s in init_states:
+            self._generated.setdefault(self._fp(s), None)
+        # job: (state, fingerprint, ebits, depth) (bfs.rs:33)
+        self._pending = deque(
+            (s, self._fp(s), self._init_ebits, 1) for s in init_states
+        )
+        self._discoveries: Dict[str, int] = {}  # property name -> fingerprint
+        self._start()
+
+    # -- exploration --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            if not self._pending:
+                return  # work exhausted
+            self._check_block()
+            if self._finish_matched(self._discoveries):
+                return
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                return
+            if self._timed_out():
+                return
+
+    def _check_block(self) -> None:
+        """Process up to BLOCK_SIZE states. Mirrors bfs.rs:177-335."""
+        model = self._model
+        pending = self._pending
+        generated = self._generated
+        discoveries = self._discoveries
+
+        for _ in range(BLOCK_SIZE):
+            if not pending:
+                return
+            state, state_fp, ebits, depth = pending.pop()
+
+            if depth > self._max_depth:
+                self._max_depth = depth
+            if self._target_max_depth is not None and depth >= self._target_max_depth:
+                continue
+            if self._visitor is not None:
+                self._visitor.visit(model, self._reconstruct_path(state_fp))
+
+            ebits, is_awaiting = self._check_properties(
+                state, ebits, discoveries, lambda: state_fp
+            )
+            if not is_awaiting:
+                return  # discoveries found for all properties (bfs.rs:278-280)
+
+            # Expand successors.
+            is_terminal = True
+            actions: list = []
+            model.actions(state, actions)
+            for action in actions:
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                if not model.within_boundary(next_state):
+                    continue
+                self._state_count += 1
+                next_fp = self._fp(next_state)
+                if next_fp in generated:
+                    # Revisit: could be a cycle or a DAG join; treated as
+                    # non-terminal (documented false-negative, bfs.rs:302-315).
+                    is_terminal = False
+                    continue
+                generated[next_fp] = state_fp
+                is_terminal = False
+                pending.appendleft((next_state, next_fp, ebits, depth + 1))
+            if is_terminal:
+                self._terminal_ebit_discoveries(
+                    ebits, discoveries, lambda: state_fp
+                )
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct_path(fp)
+            for name, fp in list(self._discoveries.items())
+        }
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        """Walk parent pointers back to an init state, then re-execute the
+        model along the fingerprint chain (bfs.rs:380-409)."""
+        fingerprints: deque = deque()
+        next_fp: Optional[int] = fp
+        while next_fp is not None and next_fp in self._generated:
+            fingerprints.appendleft(next_fp)
+            next_fp = self._generated[next_fp]
+        return Path.from_fingerprints(self._model, list(fingerprints))

@@ -1,0 +1,144 @@
+"""Shared scaffolding for the host engines.
+
+Mirrors the per-thread structure of the reference engines (spawn → background
+work loop → block processing with finish_when checks between 1500-state
+blocks; src/checker/bfs.rs:90-164, dfs.rs:93-168). CPython threads provide the
+same lifecycle semantics (join/report polling) even though the GIL serializes
+Python-level work; the parallel hot paths live in the TPU engine and the
+native core.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..checker import Checker, CheckerBuilder
+from ..core import Expectation
+
+BLOCK_SIZE = 1500  # states per finish_when re-check; reference bfs.rs:130
+
+
+class HostEngineBase(Checker):
+    """Common counters, lifecycle, and property bookkeeping for host engines."""
+
+    def __init__(self, builder: CheckerBuilder):
+        self._model = builder.model
+        self._properties = builder.model.properties()
+        self._symmetry = builder.symmetry_fn_
+        self._target_state_count = builder.target_state_count_
+        self._target_max_depth = builder.target_max_depth_
+        self._visitor = builder.visitor_
+        self._finish_when = builder.finish_when_
+        self._timeout = builder.timeout_
+        self._thread_count = builder.thread_count_
+
+        self._state_count = 0
+        self._max_depth = 0
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._deadline = (
+            time.monotonic() + self._timeout if self._timeout is not None else None
+        )
+
+        # Eventually-property bitmask: bit i set <=> property i is an
+        # eventually property not yet satisfied on the current path
+        # (reference EventuallyBits, checker.rs:580-587).
+        self._init_ebits = 0
+        for i, p in enumerate(self._properties):
+            if p.expectation == Expectation.EVENTUALLY:
+                self._init_ebits |= 1 << i
+
+        self._thread: Optional[threading.Thread] = None
+        # Pre-run snapshot for deterministic first "Checking." report lines;
+        # engines refresh it after seeding counts, before starting the thread.
+        self._initial_snapshot = (0, 0, 0)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _start(self) -> None:
+        self._initial_snapshot = (self._state_count, self.unique_state_count(), 0)
+        self._thread = threading.Thread(target=self._run_guarded, daemon=True)
+        self._thread.start()
+
+    def _run_guarded(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # surfaces at join(), like a Rust panic
+            self._error = e
+        finally:
+            self._done.set()
+
+    def _run(self) -> None:
+        raise NotImplementedError
+
+    def join(self) -> "HostEngineBase":
+        if self._thread is not None:
+            self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    # -- counters -----------------------------------------------------------
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def max_depth(self) -> int:
+        return self._max_depth
+
+    # -- shared helpers -----------------------------------------------------
+
+    def _timed_out(self) -> bool:
+        return self._deadline is not None and time.monotonic() >= self._deadline
+
+    def _fp(self, state: Any) -> int:
+        return self._model.fingerprint_state(state)
+
+    def _check_properties(
+        self, state: Any, ebits: int, discoveries: Dict[str, Any], discovery_value
+    ) -> tuple[int, bool]:
+        """Evaluate all properties on one state being processed.
+
+        Returns (updated ebits, is_awaiting_discoveries). Inserts discoveries
+        for failed always / satisfied sometimes properties. Mirrors the
+        property loop at bfs.rs:231-277 / dfs.rs:235-281.
+        """
+        model = self._model
+        is_awaiting = False
+        for i, prop in enumerate(self._properties):
+            if prop.name in discoveries:
+                continue
+            if prop.expectation == Expectation.ALWAYS:
+                if not prop.condition(model, state):
+                    discoveries[prop.name] = discovery_value()
+                else:
+                    is_awaiting = True
+            elif prop.expectation == Expectation.SOMETIMES:
+                if prop.condition(model, state):
+                    discoveries[prop.name] = discovery_value()
+                else:
+                    is_awaiting = True
+            else:  # EVENTUALLY: discoveries only arise at terminal states
+                is_awaiting = True
+                if prop.condition(model, state):
+                    ebits &= ~(1 << i)
+        return ebits, is_awaiting
+
+    def _terminal_ebit_discoveries(
+        self, ebits: int, discoveries: Dict[str, Any], discovery_value
+    ) -> None:
+        """At a terminal state, any surviving eventually-bit is a counterexample
+        (bfs.rs:326-333)."""
+        if not ebits:
+            return
+        for i, prop in enumerate(self._properties):
+            if ebits & (1 << i):
+                discoveries[prop.name] = discovery_value()
+
+    def _finish_matched(self, discoveries: Dict[str, Any]) -> bool:
+        return self._finish_when.matches(set(discoveries), self._properties)

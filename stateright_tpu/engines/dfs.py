@@ -1,0 +1,147 @@
+"""Host depth-first search engine.
+
+Reference: src/checker/dfs.rs. Exhaustive DFS carrying the full fingerprint
+path in each job (dfs.rs:31) — low memory, longer counterexamples. This is
+the engine wired to symmetry reduction: successor states are canonicalized
+via the representative function before visited-set insertion, while the job's
+path keeps the pre-canonicalized fingerprints so path reconstruction stays
+within reachable space (dfs.rs:309-318).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List
+
+from ..checker import CheckerBuilder
+from ..path import Path
+from .common import BLOCK_SIZE, HostEngineBase
+
+
+def _cons(parent, fp):
+    """Fingerprint paths are shared cons cells (parent_node, fp): O(1) per
+    successor instead of the reference's per-job Vec clone (dfs.rs:338-342),
+    which is quadratic in depth and prohibitive for deep Python searches."""
+    return (parent, fp)
+
+
+def _materialize(node) -> List[int]:
+    out: List[int] = []
+    while node is not None:
+        node, fp = node[0], node[1]
+        out.append(fp)
+    out.reverse()
+    return out
+
+
+class DfsChecker(HostEngineBase):
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        model = self._model
+        symmetry = self._symmetry
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        self._generated: set = set()  # fingerprints (of representatives if symmetry)
+        for s in init_states:
+            if symmetry is not None:
+                self._generated.add(self._fp(symmetry(s)))
+            else:
+                self._generated.add(self._fp(s))
+        # job: (state, fingerprint cons-path, ebits, depth) (dfs.rs:31)
+        self._pending = deque(
+            (s, _cons(None, self._fp(s)), self._init_ebits, 1) for s in init_states
+        )
+        self._discoveries: Dict[str, List[int]] = {}  # name -> fingerprint path
+        self._start()
+
+    # -- exploration --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            if not self._pending:
+                return
+            self._check_block()
+            if self._finish_matched(self._discoveries):
+                return
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                return
+            if self._timed_out():
+                return
+
+    def _check_block(self) -> None:
+        """Process up to BLOCK_SIZE states. Mirrors dfs.rs:182-359."""
+        model = self._model
+        symmetry = self._symmetry
+        pending = self._pending
+        generated = self._generated
+        discoveries = self._discoveries
+
+        for _ in range(BLOCK_SIZE):
+            if not pending:
+                return
+            state, fp_node, ebits, depth = pending.pop()
+
+            if depth > self._max_depth:
+                self._max_depth = depth
+            if self._target_max_depth is not None and depth >= self._target_max_depth:
+                continue
+            if self._visitor is not None:
+                self._visitor.visit(
+                    model, Path.from_fingerprints(model, _materialize(fp_node))
+                )
+
+            ebits, is_awaiting = self._check_properties(
+                state, ebits, discoveries, lambda: _materialize(fp_node)
+            )
+            if not is_awaiting:
+                return
+
+            # Expand successors (LIFO push for depth-first order).
+            is_terminal = True
+            actions: list = []
+            model.actions(state, actions)
+            for action in actions:
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                if not model.within_boundary(next_state):
+                    continue
+                self._state_count += 1
+                if symmetry is not None:
+                    rep_fp = self._fp(symmetry(next_state))
+                    if rep_fp in generated:
+                        is_terminal = False
+                        continue
+                    generated.add(rep_fp)
+                    # Continue the path with the pre-canonicalized fingerprint
+                    # so the path stays extendable (dfs.rs:315-318).
+                    next_fp = self._fp(next_state)
+                else:
+                    next_fp = self._fp(next_state)
+                    if next_fp in generated:
+                        is_terminal = False
+                        continue
+                    generated.add(next_fp)
+                is_terminal = False
+                pending.append(
+                    (next_state, _cons(fp_node, next_fp), ebits, depth + 1)
+                )
+            if is_terminal:
+                self._terminal_ebit_discoveries(
+                    ebits, discoveries, lambda: _materialize(fp_node)
+                )
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self._model, fps)
+            for name, fps in list(self._discoveries.items())
+        }

@@ -1,0 +1,219 @@
+"""Stable 64-bit state fingerprinting.
+
+Role parity with the reference's seed-stable hashing (src/lib.rs:341-349 and
+the fixed-seed `stable::hasher` at src/lib.rs:369-387): fingerprints must be
+reproducible across runs, builds, and machines, because discovery traces are
+externalized as fingerprint paths and golden tests pin exact values.
+
+Two hash domains, both with fixed seeds:
+
+1. `fingerprint(value)` — arbitrary (host-side) Python model states. The value
+   is canonically serialized (order-insensitive for sets/dicts, mirroring the
+   reference's order-insensitive `HashableHashSet`/`HashableHashMap` hashing at
+   src/util.rs:137-159) and hashed with BLAKE2b-64.
+
+2. `hash_words_np` / `hash_words_jnp` — fixed-width uint32 state rows used by
+   the tensor (TPU) engines. The same word-stream mix is implemented for
+   numpy (host) and jax.numpy (device) so host and device engines agree on
+   every fingerprint bit-for-bit. The mix is an xxhash32-style per-word
+   round + avalanche, evaluated twice with independent seeds to form a
+   64-bit fingerprint from two 32-bit halves; everything stays in uint32 so
+   it runs natively on the TPU VPU (no 64-bit emulation in the hot loop).
+
+Fingerprints are nonzero (reference: Fingerprint = NonZeroU64, src/lib.rs:341);
+zero is reserved as the empty-slot sentinel in the device visited table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import hashlib
+import struct
+from typing import Any
+
+import numpy as np
+
+# Fixed seeds (stable across runs; arbitrary odd constants of our own).
+SEED1 = np.uint32(0x9E3779B1)
+SEED2 = np.uint32(0x85EBCA77)
+
+_PRIME2 = 2246822519
+_PRIME3 = 3266489917
+_PRIME4 = 668265263
+_PRIME5 = 374761393
+
+_PERSON = b"srtpu-v1"
+
+
+# ---------------------------------------------------------------------------
+# Canonical serialization for arbitrary host states.
+# ---------------------------------------------------------------------------
+
+def _encode(value: Any, out: bytearray) -> None:
+    """Append a canonical, type-tagged encoding of `value` to `out`.
+
+    Canonical means: equal values (by our equality semantics) always produce
+    identical bytes. Sets and dicts are encoded order-insensitively by sorting
+    the element encodings, which mirrors the reference's sorted-pre-hash
+    strategy for HashableHashSet/Map (src/util.rs:137-159, 351-374).
+    """
+    if value is None:
+        out += b"N"
+    elif value is True:
+        out += b"T"
+    elif value is False:
+        out += b"F"
+    elif isinstance(value, enum.Enum):
+        out += b"E"
+        _encode(type(value).__name__, out)
+        _encode(value.name, out)
+    elif isinstance(value, (int, np.integer)):
+        v = int(value)
+        if -(2**63) <= v < 2**63:
+            out += b"i"
+            out += struct.pack("<q", v)
+        else:  # arbitrary precision
+            out += b"I"
+            b = v.to_bytes((v.bit_length() + 15) // 8, "little", signed=True)
+            out += struct.pack("<I", len(b))
+            out += b
+    elif isinstance(value, (float, np.floating)):
+        out += b"f"
+        out += struct.pack("<d", float(value))
+    elif isinstance(value, str):
+        b = value.encode("utf-8")
+        out += b"s"
+        out += struct.pack("<I", len(b))
+        out += b
+    elif isinstance(value, (bytes, bytearray)):
+        out += b"b"
+        out += struct.pack("<I", len(value))
+        out += bytes(value)
+    elif isinstance(value, (tuple, list)):
+        out += b"l"
+        out += struct.pack("<I", len(value))
+        for item in value:
+            _encode(item, out)
+    elif isinstance(value, (set, frozenset)):
+        out += b"S"
+        out += struct.pack("<I", len(value))
+        encs = []
+        for item in value:
+            buf = bytearray()
+            _encode(item, buf)
+            encs.append(bytes(buf))
+        for e in sorted(encs):
+            out += e
+    elif isinstance(value, dict):
+        out += b"D"
+        out += struct.pack("<I", len(value))
+        encs = []
+        for k, v in value.items():
+            buf = bytearray()
+            _encode(k, buf)
+            _encode(v, buf)
+            encs.append(bytes(buf))
+        for e in sorted(encs):
+            out += e
+    elif isinstance(value, np.ndarray):
+        out += b"A"
+        _encode(value.shape, out)
+        _encode(value.dtype.str, out)
+        out += np.ascontiguousarray(value).tobytes()
+    elif dataclasses.is_dataclass(value):
+        out += b"O"
+        _encode(type(value).__name__, out)
+        for field in dataclasses.fields(value):
+            if field.metadata.get("skip_fingerprint"):
+                continue
+            _encode(getattr(value, field.name), out)
+    elif hasattr(value, "fingerprint_key"):
+        out += b"K"
+        _encode(type(value).__name__, out)
+        _encode(value.fingerprint_key(), out)
+    else:
+        raise TypeError(
+            f"Cannot canonically fingerprint value of type {type(value).__name__}. "
+            "Use dataclasses, builtin containers, or define fingerprint_key()."
+        )
+
+
+def canonical_bytes(value: Any) -> bytes:
+    out = bytearray()
+    _encode(value, out)
+    return bytes(out)
+
+
+def fingerprint(value: Any) -> int:
+    """Stable nonzero 64-bit fingerprint of an arbitrary host-side state.
+
+    Reference role: `fingerprint()` at src/lib.rs:344-349.
+    """
+    digest = hashlib.blake2b(
+        canonical_bytes(value), digest_size=8, person=_PERSON
+    ).digest()
+    fp = int.from_bytes(digest, "little")
+    return fp if fp != 0 else 1
+
+
+# ---------------------------------------------------------------------------
+# Vectorized word-stream hashing for tensor states (numpy + jax twins).
+# ---------------------------------------------------------------------------
+
+def _hash_words_generic(xp, words, seed):
+    """xxhash32-style mix over the trailing axis of a uint32 array.
+
+    words: [..., S] uint32 -> [...] uint32. Identical results for xp=numpy
+    and xp=jax.numpy; all arithmetic wraps mod 2**32.
+    """
+    S = words.shape[-1]
+    acc = xp.full(words.shape[:-1], 0, dtype=xp.uint32)
+    acc = acc + xp.uint32(seed) + xp.uint32(_PRIME5) + xp.uint32(S * 4)
+    for i in range(S):
+        w = words[..., i]
+        acc = acc + w * xp.uint32(_PRIME3)
+        acc = (acc << xp.uint32(17)) | (acc >> xp.uint32(15))
+        acc = acc * xp.uint32(_PRIME4)
+    acc = acc ^ (acc >> xp.uint32(15))
+    acc = acc * xp.uint32(_PRIME2)
+    acc = acc ^ (acc >> xp.uint32(13))
+    acc = acc * xp.uint32(_PRIME3)
+    acc = acc ^ (acc >> xp.uint32(16))
+    return acc
+
+
+def hash_words_np(words: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Hash uint32 rows -> (h1, h2) uint32 pair; (h1<<32)|h2 is the fingerprint.
+
+    Guaranteed nonzero as a pair: if both halves are zero, h2 is forced to 1,
+    matching the NonZeroU64 fingerprint invariant (src/lib.rs:341-349).
+    """
+    words = np.asarray(words, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        h1 = _hash_words_generic(np, words, SEED1)
+        h2 = _hash_words_generic(np, words, SEED2)
+    both_zero = (h1 == 0) & (h2 == 0)
+    h2 = np.where(both_zero, np.uint32(1), h2)
+    return h1, h2
+
+
+def hash_words_jnp(words):
+    """JAX twin of `hash_words_np` (jit-friendly; uint32 all the way)."""
+    import jax.numpy as jnp
+
+    words = words.astype(jnp.uint32)
+    h1 = _hash_words_generic(jnp, words, int(SEED1))
+    h2 = _hash_words_generic(jnp, words, int(SEED2))
+    both_zero = (h1 == 0) & (h2 == 0)
+    h2 = jnp.where(both_zero, jnp.uint32(1), h2)
+    return h1, h2
+
+
+def combine64(h1, h2) -> int:
+    """Combine a (h1, h2) uint32 pair into the canonical 64-bit fingerprint int."""
+    return (int(h1) << 32) | int(h2)
+
+
+def split64(fp: int) -> tuple[int, int]:
+    return (fp >> 32) & 0xFFFFFFFF, fp & 0xFFFFFFFF

@@ -1,0 +1,22 @@
+"""Example and fixture models.
+
+Fixtures (`fixtures.py`) mirror the reference's src/test_util.rs models used
+to test the engines themselves. Protocol examples (two_phase_commit,
+increment, …) mirror the reference's examples/ directory and double as the
+integration-test and benchmark suite, with golden unique-state counts.
+"""
+
+from .fixtures import BinaryClock, DGraph, LinearEquation, Panicker
+from .two_phase_commit import TwoPhaseSys, TwoPhaseTensor
+from .increment import Increment, IncrementTensor
+
+__all__ = [
+    "BinaryClock",
+    "DGraph",
+    "Increment",
+    "IncrementTensor",
+    "LinearEquation",
+    "Panicker",
+    "TwoPhaseSys",
+    "TwoPhaseTensor",
+]

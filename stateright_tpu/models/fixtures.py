@@ -1,0 +1,126 @@
+"""Fixture models for testing the engines themselves.
+
+Reference: src/test_util.rs — binary_clock (2-state machine), dgraph
+(arbitrary graph from paths; used for eventually-property semantics tests),
+linear_equation_solver (the canonical engine test: 256x256 u8 space), and
+panicker (clean shutdown when user code raises).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..core import Model, Property
+
+
+class BinaryClock(Model):
+    """Cycles between 0 and 1. Reference: test_util.rs:3-47."""
+
+    GO_LOW = "GoLow"
+    GO_HIGH = "GoHigh"
+
+    def init_states(self) -> List[int]:
+        return [0, 1]
+
+    def actions(self, state: int, actions: List[str]) -> None:
+        if state == 0:
+            actions.append(self.GO_HIGH)
+        else:
+            actions.append(self.GO_LOW)
+
+    def next_state(self, state: int, action: str):
+        return 1 if action == self.GO_HIGH else 0
+
+    def properties(self) -> List[Property]:
+        return [Property.always("in [0, 1]", lambda _m, s: 0 <= s <= 1)]
+
+
+class DGraph(Model):
+    """A directed graph specified via paths from initial states.
+
+    Reference: test_util.rs:49-116. States and actions are small ints; the
+    action *is* the destination state.
+    """
+
+    def __init__(self, property: Property):
+        self.inits: Set[int] = set()
+        self.edges: Dict[int, Set[int]] = {}
+        self._property = property
+
+    @staticmethod
+    def with_property(property: Property) -> "DGraph":
+        return DGraph(property)
+
+    def with_path(self, path: List[int]) -> "DGraph":
+        src = path[0]
+        self.inits.add(src)
+        for dst in path[1:]:
+            self.edges.setdefault(src, set()).add(dst)
+            src = dst
+        return self
+
+    def check(self):
+        return self.checker().spawn_bfs().join()
+
+    def init_states(self) -> List[int]:
+        return sorted(self.inits)
+
+    def actions(self, state: int, actions: List[int]) -> None:
+        actions.extend(sorted(self.edges.get(state, ())))
+
+    def next_state(self, _state: int, action: int) -> int:
+        return action
+
+    def properties(self) -> List[Property]:
+        return [self._property]
+
+
+class LinearEquation(Model):
+    """Solve a*x + b*y = c in u8 by guessing increments.
+
+    Reference: test_util.rs:139-192. Full state space is 256*256 = 65,536.
+    """
+
+    INCREASE_X = "IncreaseX"
+    INCREASE_Y = "IncreaseY"
+
+    def __init__(self, a: int, b: int, c: int):
+        self.a, self.b, self.c = a, b, c
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, _state, actions: List[str]) -> None:
+        actions.append(self.INCREASE_X)
+        actions.append(self.INCREASE_Y)
+
+    def next_state(self, state, action: str):
+        x, y = state
+        if action == self.INCREASE_X:
+            return ((x + 1) % 256, y)
+        return (x, (y + 1) % 256)
+
+    def properties(self) -> List[Property]:
+        def solvable(model: "LinearEquation", solution) -> bool:
+            x, y = solution
+            return (model.a * x + model.b * y) % 256 == model.c % 256
+
+        return [Property.sometimes("solvable", solvable)]
+
+
+class Panicker(Model):
+    """Raises during checking once state 5 is expanded. Reference: test_util.rs:194-228."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, _state, actions: List[int]) -> None:
+        actions.append(1)
+
+    def next_state(self, last_state: int, action: int):
+        if last_state == 5:
+            raise RuntimeError("reached panic state")
+        return last_state + action
+
+    def properties(self) -> List[Property]:
+        return [Property.always("true", lambda _m, _s: True)]

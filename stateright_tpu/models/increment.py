@@ -1,0 +1,127 @@
+"""Unsynchronized shared-memory counter: the classic lost-update race.
+
+Reference: examples/increment.rs — N threads each read the shared counter
+then write back the increment; interleavings break the invariant that the
+counter equals the number of finished threads (13 unique states at N=2,
+8 with symmetry reduction; the "fin" always-property has a counterexample).
+
+`Increment` is the host model; `IncrementTensor` is the dense TPU encoding
+(one lane for the shared counter, two lanes per thread for local value and
+program counter).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ..core import Model, Property
+from ..tensor import TensorModel, TensorProperty
+
+
+@dataclass(frozen=True)
+class IncrementState:
+    i: int
+    s: Tuple[Tuple[int, int], ...]  # per-thread (t, pc)
+
+    def representative(self) -> "IncrementState":
+        """Sort the identical threads (examples/increment.rs:142-151)."""
+        return IncrementState(self.i, tuple(sorted(self.s)))
+
+
+class Increment(Model):
+    """Host model. Reference: examples/increment.rs:153-197."""
+
+    def __init__(self, thread_count: int):
+        self.n = thread_count
+
+    def init_states(self) -> List[IncrementState]:
+        return [IncrementState(0, ((0, 1),) * self.n)]
+
+    def actions(self, state: IncrementState, actions: List) -> None:
+        for tid in range(self.n):
+            pc = state.s[tid][1]
+            if pc == 1:
+                actions.append(("Read", tid))
+            elif pc == 2:
+                actions.append(("Write", tid))
+
+    def next_state(self, state: IncrementState, action) -> IncrementState:
+        kind, tid = action
+        s = list(state.s)
+        if kind == "Read":
+            s[tid] = (state.i, 2)
+            return IncrementState(state.i, tuple(s))
+        t = state.s[tid][0]
+        s[tid] = (t, 3)
+        return IncrementState((t + 1) % 256, tuple(s))
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.always(
+                "fin",
+                lambda _m, s: sum(1 for (_t, pc) in s.s if pc == 3) % 256 == s.i,
+            )
+        ]
+
+
+class IncrementTensor(TensorModel):
+    """Dense encoding: lane 0 = shared counter; lanes 1+2k / 2+2k = thread k's
+    local value and program counter. Actions: slot 2k = Read(k), 2k+1 = Write(k).
+    """
+
+    def __init__(self, thread_count: int):
+        self.n = thread_count
+        self.state_width = 1 + 2 * thread_count
+        self.max_actions = 2 * thread_count
+
+    def init_states_array(self) -> np.ndarray:
+        row = np.zeros(self.state_width, dtype=np.uint32)
+        for k in range(self.n):
+            row[2 + 2 * k] = 1  # pc = 1
+        return row[None, :]
+
+    def step_batch(self, xp, states):
+        u = xp.uint32
+        succs = []
+        masks = []
+        shared = states[:, 0]
+        for k in range(self.n):
+            t = states[:, 1 + 2 * k]
+            pc = states[:, 2 + 2 * k]
+
+            # Read(k): t <- shared, pc <- 2
+            cols = [states[:, j] for j in range(self.state_width)]
+            cols[1 + 2 * k] = shared
+            cols[2 + 2 * k] = xp.full_like(pc, 2)
+            succs.append(xp.stack(cols, axis=-1))
+            masks.append(pc == u(1))
+
+            # Write(k): shared <- t + 1, pc <- 3
+            cols = [states[:, j] for j in range(self.state_width)]
+            cols[0] = (t + u(1)) & u(0xFF)
+            cols[2 + 2 * k] = xp.full_like(pc, 3)
+            succs.append(xp.stack(cols, axis=-1))
+            masks.append(pc == u(2))
+
+        return xp.stack(succs, axis=1), xp.stack(masks, axis=1)
+
+    def tensor_properties(self) -> List[TensorProperty]:
+        n = self.n
+
+        def fin(xp, states):
+            finished = states[:, 2] == xp.uint32(3)
+            count = finished.astype(xp.uint32)
+            for k in range(1, n):
+                count = count + (states[:, 2 + 2 * k] == xp.uint32(3)).astype(
+                    xp.uint32
+                )
+            return (count & xp.uint32(0xFF)) == states[:, 0]
+
+        return [TensorProperty.always("fin", fin)]
+
+    def format_action(self, a: int) -> str:
+        tid, kind = divmod(a, 2)
+        return f"{'Read' if kind == 0 else 'Write'}({tid})"

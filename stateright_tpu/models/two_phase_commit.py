@@ -1,0 +1,354 @@
+"""Two-phase commit, after Gray & Lamport's "Consensus on Transaction Commit".
+
+Reference: examples/2pc.rs — an abstract TLA+-style model (no actors). Golden
+unique-state counts: 288 at 3 RMs, 8,832 at 5 RMs, 665 at 5 RMs with symmetry
+reduction (examples/2pc.rs:149-170).
+
+Two implementations of the same system:
+
+  - `TwoPhaseSys`: a host `Model` over rich Python states, action order
+    matching the reference for golden parity.
+  - `TwoPhaseTensor`: the TPU-native `TensorModel` — the whole system state
+    packs into 3 uint32 lanes (TM state, TM-prepared bitmask + RM states at
+    2 bits each, message-set bitmask), and all 2+5N actions are evaluated as
+    one masked batch. This dense encoding is what the batched frontier engine
+    explores at full speed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import FrozenSet, List, Tuple
+
+import numpy as np
+
+from ..core import Model, Property
+from ..tensor import TensorModel, TensorProperty
+
+# RM states
+WORKING, PREPARED, COMMITTED, ABORTED = 0, 1, 2, 3
+# TM states
+TM_INIT, TM_COMMITTED, TM_ABORTED = 0, 1, 2
+
+# Messages are encoded as ints: Prepared{rm} = rm, Commit = -1, Abort = -2.
+MSG_COMMIT = -1
+MSG_ABORT = -2
+
+
+@dataclass(frozen=True)
+class TwoPhaseState:
+    rm_state: Tuple[int, ...]
+    tm_state: int
+    tm_prepared: Tuple[bool, ...]
+    msgs: FrozenSet[int]
+
+    def representative(self) -> "TwoPhaseState":
+        """Canonicalize under RM-identity permutation (examples/2pc.rs:203-229).
+
+        Sort RMs by their local state, reindexing tm_prepared and Prepared
+        messages with the same permutation.
+        """
+        n = len(self.rm_state)
+        order = sorted(range(n), key=lambda i: self.rm_state[i])
+        inverse = [0] * n
+        for new_i, old_i in enumerate(order):
+            inverse[old_i] = new_i
+        return TwoPhaseState(
+            rm_state=tuple(self.rm_state[i] for i in order),
+            tm_state=self.tm_state,
+            tm_prepared=tuple(self.tm_prepared[i] for i in order),
+            msgs=frozenset(
+                m if m < 0 else inverse[m] for m in self.msgs
+            ),
+        )
+
+
+class TwoPhaseSys(Model):
+    """Host model. Reference: examples/2pc.rs:59-147."""
+
+    def __init__(self, rm_count: int):
+        self.rm_count = rm_count
+
+    def init_states(self) -> List[TwoPhaseState]:
+        n = self.rm_count
+        return [
+            TwoPhaseState(
+                rm_state=(WORKING,) * n,
+                tm_state=TM_INIT,
+                tm_prepared=(False,) * n,
+                msgs=frozenset(),
+            )
+        ]
+
+    def actions(self, state: TwoPhaseState, actions: List) -> None:
+        if state.tm_state == TM_INIT and all(state.tm_prepared):
+            actions.append(("TmCommit",))
+        if state.tm_state == TM_INIT:
+            actions.append(("TmAbort",))
+        for rm in range(self.rm_count):
+            if state.tm_state == TM_INIT and rm in state.msgs:
+                actions.append(("TmRcvPrepared", rm))
+            if state.rm_state[rm] == WORKING:
+                actions.append(("RmPrepare", rm))
+            if state.rm_state[rm] == WORKING:
+                actions.append(("RmChooseToAbort", rm))
+            if MSG_COMMIT in state.msgs:
+                actions.append(("RmRcvCommitMsg", rm))
+            if MSG_ABORT in state.msgs:
+                actions.append(("RmRcvAbortMsg", rm))
+
+    def next_state(self, s: TwoPhaseState, action) -> TwoPhaseState:
+        kind = action[0]
+        if kind == "TmRcvPrepared":
+            rm = action[1]
+            prepared = list(s.tm_prepared)
+            prepared[rm] = True
+            return replace(s, tm_prepared=tuple(prepared))
+        if kind == "TmCommit":
+            return replace(s, tm_state=TM_COMMITTED, msgs=s.msgs | {MSG_COMMIT})
+        if kind == "TmAbort":
+            return replace(s, tm_state=TM_ABORTED, msgs=s.msgs | {MSG_ABORT})
+        rm = action[1]
+        rm_state = list(s.rm_state)
+        if kind == "RmPrepare":
+            rm_state[rm] = PREPARED
+            return replace(s, rm_state=tuple(rm_state), msgs=s.msgs | {rm})
+        if kind == "RmChooseToAbort":
+            rm_state[rm] = ABORTED
+        elif kind == "RmRcvCommitMsg":
+            rm_state[rm] = COMMITTED
+        elif kind == "RmRcvAbortMsg":
+            rm_state[rm] = ABORTED
+        return replace(s, rm_state=tuple(rm_state))
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.sometimes(
+                "abort agreement",
+                lambda _m, s: all(r == ABORTED for r in s.rm_state),
+            ),
+            Property.sometimes(
+                "commit agreement",
+                lambda _m, s: all(r == COMMITTED for r in s.rm_state),
+            ),
+            Property.always(
+                "consistent",
+                lambda _m, s: not (
+                    ABORTED in s.rm_state and COMMITTED in s.rm_state
+                ),
+            ),
+        ]
+
+
+class TwoPhaseTensor(TensorModel):
+    """TPU-native dense encoding of two-phase commit.
+
+    State layout (3 uint32 lanes, N RMs <= 16):
+      lane 0: tm_state (2 bits)
+      lane 1: bits [2i, 2i+1] = rm_state[i]; bits 16+i not used
+      lane 2: bit i = Prepared{i} in msgs; bit 29 = tm_prepared bitmask is
+              folded into lane 0 bits [2+i]; bit 30 = Commit, bit 31 = Abort
+
+    Concretely: lane0 = tm_state | (tm_prepared_mask << 2);
+                lane1 = packed 2-bit rm states;
+                lane2 = prepared_msgs_mask | commit_bit<<30 | abort_bit<<31.
+
+    Actions (A = 2 + 5N): slot 0 TmCommit, slot 1 TmAbort, then for each rm:
+    TmRcvPrepared, RmPrepare, RmChooseToAbort, RmRcvCommitMsg, RmRcvAbortMsg.
+    """
+
+    state_width = 3
+
+    def __init__(self, rm_count: int):
+        if rm_count > 16:
+            raise ValueError("TwoPhaseTensor supports up to 16 RMs")
+        self.n = rm_count
+        self.max_actions = 2 + 5 * rm_count
+
+    def init_states_array(self) -> np.ndarray:
+        return np.zeros((1, 3), dtype=np.uint32)
+
+    # -- lane helpers (work under numpy and jax.numpy) ----------------------
+
+    @staticmethod
+    def _tm_state(xp, lane0):
+        return lane0 & xp.uint32(3)
+
+    def _prepared_mask(self, xp, lane0):
+        return (lane0 >> xp.uint32(2)) & xp.uint32((1 << self.n) - 1)
+
+    @staticmethod
+    def _rm_state(xp, lane1, rm: int):
+        return (lane1 >> xp.uint32(2 * rm)) & xp.uint32(3)
+
+    def step_batch(self, xp, states):
+        n = self.n
+        u = xp.uint32
+        lane0, lane1, lane2 = states[:, 0], states[:, 1], states[:, 2]
+        tm = self._tm_state(xp, lane0)
+        prep_mask = self._prepared_mask(xp, lane0)
+        all_prepared = prep_mask == u((1 << n) - 1)
+        tm_init = tm == u(TM_INIT)
+        has_commit = (lane2 >> u(30)) & u(1)
+        has_abort = (lane2 >> u(31)) & u(1)
+
+        succs = []
+        masks = []
+
+        # slot 0: TmCommit
+        s0 = xp.stack(
+            [
+                (lane0 & ~u(3)) | u(TM_COMMITTED),
+                lane1,
+                lane2 | (u(1) << u(30)),
+            ],
+            axis=-1,
+        )
+        succs.append(s0)
+        masks.append(tm_init & all_prepared)
+
+        # slot 1: TmAbort
+        s1 = xp.stack(
+            [
+                (lane0 & ~u(3)) | u(TM_ABORTED),
+                lane1,
+                lane2 | (u(1) << u(31)),
+            ],
+            axis=-1,
+        )
+        succs.append(s1)
+        masks.append(tm_init)
+
+        for rm in range(n):
+            rm_working = self._rm_state(xp, lane1, rm) == u(WORKING)
+            prepared_msg = ((lane2 >> u(rm)) & u(1)) == u(1)
+            rm_shift = u(2 * rm)
+            rm_clear = ~(u(3) << rm_shift)
+
+            # TmRcvPrepared(rm)
+            succs.append(
+                xp.stack(
+                    [lane0 | (u(1) << u(2 + rm)), lane1, lane2], axis=-1
+                )
+            )
+            masks.append(tm_init & prepared_msg)
+
+            # RmPrepare(rm)
+            succs.append(
+                xp.stack(
+                    [
+                        lane0,
+                        (lane1 & rm_clear) | (u(PREPARED) << rm_shift),
+                        lane2 | (u(1) << u(rm)),
+                    ],
+                    axis=-1,
+                )
+            )
+            masks.append(rm_working)
+
+            # RmChooseToAbort(rm)
+            succs.append(
+                xp.stack(
+                    [
+                        lane0,
+                        (lane1 & rm_clear) | (u(ABORTED) << rm_shift),
+                        lane2,
+                    ],
+                    axis=-1,
+                )
+            )
+            masks.append(rm_working)
+
+            # RmRcvCommitMsg(rm)
+            succs.append(
+                xp.stack(
+                    [
+                        lane0,
+                        (lane1 & rm_clear) | (u(COMMITTED) << rm_shift),
+                        lane2,
+                    ],
+                    axis=-1,
+                )
+            )
+            masks.append(has_commit == u(1))
+
+            # RmRcvAbortMsg(rm)
+            succs.append(
+                xp.stack(
+                    [
+                        lane0,
+                        (lane1 & rm_clear) | (u(ABORTED) << rm_shift),
+                        lane2,
+                    ],
+                    axis=-1,
+                )
+            )
+            masks.append(has_abort == u(1))
+
+        return xp.stack(succs, axis=1), xp.stack(masks, axis=1)
+
+    def tensor_properties(self) -> List[TensorProperty]:
+        n = self.n
+
+        def rm_states(xp, states):
+            lane1 = states[:, 1]
+            return [
+                (lane1 >> xp.uint32(2 * rm)) & xp.uint32(3) for rm in range(n)
+            ]
+
+        def abort_agreement(xp, states):
+            rs = rm_states(xp, states)
+            acc = rs[0] == xp.uint32(ABORTED)
+            for r in rs[1:]:
+                acc = acc & (r == xp.uint32(ABORTED))
+            return acc
+
+        def commit_agreement(xp, states):
+            rs = rm_states(xp, states)
+            acc = rs[0] == xp.uint32(COMMITTED)
+            for r in rs[1:]:
+                acc = acc & (r == xp.uint32(COMMITTED))
+            return acc
+
+        def consistent(xp, states):
+            rs = rm_states(xp, states)
+            any_abort = rs[0] == xp.uint32(ABORTED)
+            any_commit = rs[0] == xp.uint32(COMMITTED)
+            for r in rs[1:]:
+                any_abort = any_abort | (r == xp.uint32(ABORTED))
+                any_commit = any_commit | (r == xp.uint32(COMMITTED))
+            return ~(any_abort & any_commit)
+
+        return [
+            TensorProperty.sometimes("abort agreement", abort_agreement),
+            TensorProperty.sometimes("commit agreement", commit_agreement),
+            TensorProperty.always("consistent", consistent),
+        ]
+
+    def format_action(self, a: int) -> str:
+        if a == 0:
+            return "TmCommit"
+        if a == 1:
+            return "TmAbort"
+        rm, kind = divmod(a - 2, 5)
+        return [
+            f"TmRcvPrepared({rm})",
+            f"RmPrepare({rm})",
+            f"RmChooseToAbort({rm})",
+            f"RmRcvCommitMsg({rm})",
+            f"RmRcvAbortMsg({rm})",
+        ][kind]
+
+    def decode_state(self, row) -> dict:
+        lane0, lane1, lane2 = (int(v) for v in row)
+        names = {0: "Working", 1: "Prepared", 2: "Committed", 3: "Aborted"}
+        return {
+            "tm_state": {0: "Init", 1: "Committed", 2: "Aborted"}[lane0 & 3],
+            "tm_prepared": [(lane0 >> (2 + i)) & 1 == 1 for i in range(self.n)],
+            "rm_state": [names[(lane1 >> (2 * i)) & 3] for i in range(self.n)],
+            "msgs": sorted(
+                [f"Prepared({i})" for i in range(self.n) if (lane2 >> i) & 1]
+                + (["Commit"] if (lane2 >> 30) & 1 else [])
+                + (["Abort"] if (lane2 >> 31) & 1 else [])
+            ),
+        }

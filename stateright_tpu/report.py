@@ -1,0 +1,69 @@
+"""Progress reporting during checking runs.
+
+Reference: src/report.rs. `WriteReporter` prints the same line formats the
+reference's bench harness greps ("Done. states=… unique=… depth=… sec=…",
+report.rs:66-74).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, TextIO
+
+
+@dataclass
+class ReportData:
+    """Reference: report.rs:10-21."""
+
+    total_states: int
+    unique_states: int
+    max_depth: int
+    duration_secs: float
+    done: bool
+
+
+@dataclass
+class ReportDiscovery:
+    """Reference: report.rs:24-32."""
+
+    path: Any  # Path
+    classification: Any  # DiscoveryClassification
+
+
+class Reporter:
+    """Reference: report.rs:35-48."""
+
+    def report_checking(self, data: ReportData) -> None:
+        raise NotImplementedError
+
+    def report_discoveries(self, model, discoveries: Dict[str, ReportDiscovery]) -> None:
+        raise NotImplementedError
+
+    def delay(self) -> float:
+        """Seconds between progress samples (reference default 1s, report.rs:46-47)."""
+        return 1.0
+
+
+class WriteReporter(Reporter):
+    """Writes progress lines to a file-like object. Reference: report.rs:50-98."""
+
+    def __init__(self, writer: TextIO):
+        self.writer = writer
+
+    def report_checking(self, data: ReportData) -> None:
+        if data.done:
+            self.writer.write(
+                f"Done. states={data.total_states}, unique={data.unique_states}, "
+                f"depth={data.max_depth}, sec={int(data.duration_secs)}\n"
+            )
+        else:
+            self.writer.write(
+                f"Checking. states={data.total_states}, "
+                f"unique={data.unique_states}, depth={data.max_depth}\n"
+            )
+
+    def report_discoveries(self, model, discoveries: Dict[str, ReportDiscovery]) -> None:
+        for name in sorted(discoveries):
+            d = discoveries[name]
+            self.writer.write(f'Discovered "{name}" {d.classification} {d.path}')
+            self.writer.write(f"Fingerprint path: {d.path.encode(model)}\n")

@@ -1,0 +1,187 @@
+"""The tensor encoding layer: fixed-width state encodings for batched checking.
+
+This is the keystone of the TPU-first design (no reference counterpart — the
+reference explores Rust object graphs; SURVEY.md section 7 step 3). A
+`TensorModel` describes the same transition system as a `Model`, but as pure
+array programs over fixed-width uint32 state rows:
+
+  - a state is a `[S]` uint32 vector (`state_width` lanes),
+  - a batch of states is `[B, S]`,
+  - `step_batch(xp, states)` maps `[B, S] -> ([B, A, S] successors,
+    [B, A] validity mask)` where `A = max_actions` is the static fanout bound
+    (ragged action sets become masked padding — XLA needs static shapes),
+  - properties are batched predicates `[B, S] -> [B]` bool.
+
+`step_batch` receives the array namespace `xp` (numpy or jax.numpy) so one
+definition serves both the host engines (vectorized numpy, and single-row via
+the `TensorModelAdapter`) and the TPU engine (jit + vmap over the frontier).
+Keeping a single source of truth is what makes host/TPU discovery-output
+equivalence checkable.
+
+Fingerprints of tensor states are computed by the shared word-stream hash
+(`stateright_tpu.fingerprint.hash_words_*`), bit-identical on host and device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .core import Expectation, Model, Property
+from .fingerprint import combine64, hash_words_np
+
+
+@dataclass
+class TensorProperty:
+    """A batched property predicate: check(xp, states[B,S]) -> bool[B]."""
+
+    expectation: Expectation
+    name: str
+    check: Callable[[Any, Any], Any]
+
+    @staticmethod
+    def always(name: str, check) -> "TensorProperty":
+        return TensorProperty(Expectation.ALWAYS, name, check)
+
+    @staticmethod
+    def eventually(name: str, check) -> "TensorProperty":
+        return TensorProperty(Expectation.EVENTUALLY, name, check)
+
+    @staticmethod
+    def sometimes(name: str, check) -> "TensorProperty":
+        return TensorProperty(Expectation.SOMETIMES, name, check)
+
+
+class TensorModel:
+    """A transition system over fixed-width uint32 state rows.
+
+    Subclasses define `state_width`, `max_actions`, `init_states_array`,
+    `step_batch`, and `tensor_properties`; optionally
+    `within_boundary_batch`, `decode_state` / `format_action` for display.
+    """
+
+    state_width: int
+    max_actions: int
+
+    # -- required interface -------------------------------------------------
+
+    def init_states_array(self) -> np.ndarray:
+        """[N0, S] uint32 initial states."""
+        raise NotImplementedError
+
+    def step_batch(self, xp, states):
+        """states[B, S] -> (succs[B, A, S], mask[B, A] bool).
+
+        Must be a pure array program valid under jax.jit (no data-dependent
+        Python control flow; elementwise/gather ops only) and equally valid
+        under numpy. Invalid action slots may contain arbitrary state data —
+        they are masked out.
+        """
+        raise NotImplementedError
+
+    def tensor_properties(self) -> List[TensorProperty]:
+        return []
+
+    # -- optional interface -------------------------------------------------
+
+    def within_boundary_batch(self, xp, states):
+        """states[B, S] -> bool[B]; default: everything is in bounds."""
+        return xp.ones(states.shape[0], dtype=bool)
+
+    def decode_state(self, row: np.ndarray) -> Any:
+        """Human-readable view of one state row (Explorer / error messages)."""
+        return tuple(int(v) for v in row)
+
+    def format_action(self, action_index: int) -> str:
+        return f"action[{action_index}]"
+
+    # -- derived ------------------------------------------------------------
+
+    def fingerprint_row(self, row: np.ndarray) -> int:
+        h1, h2 = hash_words_np(np.asarray(row, dtype=np.uint32)[None, :])
+        return combine64(h1[0], h2[0])
+
+    def checker(self):
+        """Build a checker over the host-facing adapter view of this model."""
+        return TensorModelAdapter(self).checker()
+
+
+class _AdapterProperty:
+    """Bridges a TensorProperty to a host (model, state) predicate."""
+
+    def __init__(self, tensor_prop: TensorProperty):
+        self._tp = tensor_prop
+
+    def __call__(self, model: "TensorModelAdapter", state: Tuple[int, ...]) -> bool:
+        row = np.asarray(state, dtype=np.uint32)[None, :]
+        return bool(np.asarray(self._tp.check(np, row))[0])
+
+
+class TensorModelAdapter(Model):
+    """Presents a TensorModel through the host `Model` interface.
+
+    States are tuples of ints (one per lane); actions are action indices.
+    Host BFS/DFS run the tensor model through numpy single rows, guaranteeing
+    the host and TPU engines execute the *same* transition function — the
+    host run is the correctness oracle for the TPU run.
+    """
+
+    def __init__(self, tensor_model: TensorModel):
+        self.tm = tensor_model
+        # Single-entry step memo: engines call actions(s) then next_state(s, a)
+        # once per action on the same state, which would otherwise recompute
+        # the full step_batch A+1 times per expansion.
+        self._memo_key: Optional[Tuple[int, ...]] = None
+        self._memo_val: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    # -- Model interface ----------------------------------------------------
+
+    def init_states(self) -> List[Tuple[int, ...]]:
+        arr = np.asarray(self.tm.init_states_array(), dtype=np.uint32)
+        return [tuple(int(v) for v in row) for row in arr]
+
+    def actions(self, state, actions: List[int]) -> None:
+        _succs, mask = self._step_row(state)
+        for a in range(self.tm.max_actions):
+            if mask[a]:
+                actions.append(a)
+
+    def next_state(self, last_state, action: int) -> Optional[Tuple[int, ...]]:
+        succs, mask = self._step_row(last_state)
+        if not mask[action]:
+            return None
+        return tuple(int(v) for v in succs[action])
+
+    def properties(self) -> List[Property]:
+        return [
+            Property(tp.expectation, tp.name, _AdapterProperty(tp))
+            for tp in self.tm.tensor_properties()
+        ]
+
+    def within_boundary(self, state) -> bool:
+        row = np.asarray(state, dtype=np.uint32)[None, :]
+        return bool(np.asarray(self.tm.within_boundary_batch(np, row))[0])
+
+    def format_action(self, action: int) -> str:
+        return self.tm.format_action(action)
+
+    def fingerprint_state(self, state) -> int:
+        """Shared word hash => identical fingerprints on host and device."""
+        return self.tm.fingerprint_row(np.asarray(state, dtype=np.uint32))
+
+    # -- helpers ------------------------------------------------------------
+
+    def _step_row(self, state) -> Tuple[np.ndarray, np.ndarray]:
+        key = tuple(state)
+        if key == self._memo_key and self._memo_val is not None:
+            return self._memo_val
+        row = np.asarray(state, dtype=np.uint32)[None, :]
+        succs, mask = self.tm.step_batch(np, row)
+        val = (
+            np.asarray(succs, dtype=np.uint32)[0],
+            np.asarray(mask, dtype=bool)[0],
+        )
+        self._memo_key, self._memo_val = key, val
+        return val

@@ -1,0 +1,79 @@
+"""Per-visited-state callbacks for checking runs.
+
+Reference: src/checker/visitor.rs — `CheckerVisitor`, `PathRecorder`
+(records the set of visited paths), `StateRecorder` (records evaluated states
+in visit order; the BFS/DFS visit-order golden tests depend on it).
+Plain callables are accepted wherever a visitor is expected.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Set
+
+
+class CheckerVisitor:
+    """Reference: visitor.rs:19-31."""
+
+    def visit(self, model, path) -> None:
+        raise NotImplementedError
+
+
+class _FnVisitor(CheckerVisitor):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def visit(self, model, path) -> None:
+        self.fn(path)
+
+
+def as_visitor(v) -> CheckerVisitor:
+    if isinstance(v, CheckerVisitor):
+        return v
+    if callable(v):
+        return _FnVisitor(v)
+    raise TypeError(f"not a visitor: {v!r}")
+
+
+class PathRecorder(CheckerVisitor):
+    """Records every visited Path. Reference: visitor.rs:47-73."""
+
+    def __init__(self):
+        self._paths: Set = set()
+        self._lock = threading.Lock()
+
+    def visit(self, model, path) -> None:
+        with self._lock:
+            self._paths.add(path)
+
+    @staticmethod
+    def new_with_accessor():
+        recorder = PathRecorder()
+
+        def accessor() -> Set:
+            with recorder._lock:
+                return set(recorder._paths)
+
+        return recorder, accessor
+
+
+class StateRecorder(CheckerVisitor):
+    """Records evaluated states in visit order. Reference: visitor.rs:87-111."""
+
+    def __init__(self):
+        self._states: List[Any] = []
+        self._lock = threading.Lock()
+
+    def visit(self, model, path) -> None:
+        with self._lock:
+            self._states.append(path.last_state())
+
+    @staticmethod
+    def new_with_accessor():
+        recorder = StateRecorder()
+
+        def accessor() -> List[Any]:
+            with recorder._lock:
+                return list(recorder._states)
+
+        return recorder, accessor

@@ -1,0 +1,28 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding correctness is
+validated on a forced 8-device CPU platform (the driver separately dry-runs
+the multi-chip path via __graft_entry__.dryrun_multichip).
+
+NOTE: in this environment a sitecustomize hook imports jax at interpreter
+boot and pins jax_platforms to the axon TPU backend — setting JAX_PLATFORMS
+in the environment here is too late. Overriding the jax config directly
+(before any backend is initialized) is what actually keeps tests off the
+TPU tunnel.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

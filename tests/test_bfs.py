@@ -1,0 +1,76 @@
+"""Host BFS engine tests. Mirrors src/checker/bfs.rs:411-489 test module."""
+
+import pytest
+
+from stateright_tpu import StateRecorder, WriteReporter
+from stateright_tpu.models import LinearEquation, Panicker
+
+
+def test_visits_states_in_bfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_bfs().join()
+    assert accessor() == [
+        (0, 0),  # distance 0
+        (1, 0), (0, 1),  # distance 1
+        (2, 0), (1, 1), (0, 2),  # distance 2
+        (3, 0), (2, 1),  # distance 3
+    ]
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(2, 4, 7).checker().spawn_bfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 12
+
+    # BFS finds the shortest example: (2*2 + 10*1) % 256 == 14.
+    assert checker.discovery("solvable").into_actions() == [
+        "IncreaseX", "IncreaseX", "IncreaseY",
+    ]
+    # ... and other solutions are also valid discoveries: (10*27) % 256 == 14.
+    checker.assert_discovery("solvable", ["IncreaseY"] * 27)
+
+
+def test_report_format():
+    import io
+
+    out = io.StringIO()
+    LinearEquation(2, 10, 14).checker().spawn_bfs().report(WriteReporter(out))
+    text = out.getvalue()
+    assert text.startswith(
+        "Checking. states=1, unique=1, depth=0\n"
+        "Done. states=15, unique=12, depth=4, sec="
+    )
+    assert 'Discovered "solvable" example Path[3]:' in text
+    assert "- 'IncreaseX'" in text
+    assert "Fingerprint path: " in text
+
+
+def test_handles_panics_gracefully():
+    with pytest.raises(RuntimeError, match="reached panic state"):
+        Panicker().checker().spawn_bfs().join()
+
+
+def test_target_state_count_stops_early():
+    checker = (
+        LinearEquation(2, 4, 7).checker().target_state_count(1000).spawn_bfs().join()
+    )
+    assert checker.is_done()
+    assert checker.state_count() >= 1000
+    assert checker.unique_state_count() < 65536
+
+
+def test_target_max_depth_limits_depth():
+    checker = (
+        LinearEquation(2, 4, 7).checker().target_max_depth(3).spawn_bfs().join()
+    )
+    assert checker.max_depth() == 3
+    # Depth-3 jobs are popped but skipped, so generated states reach depth 3:
+    # (0,0) + {(1,0),(0,1)} + {(2,0),(1,1),(0,2)} = 6 unique states.
+    assert checker.unique_state_count() == 6

@@ -1,0 +1,45 @@
+"""Host DFS engine tests. Mirrors src/checker/dfs.rs:404-585 test module."""
+
+import io
+
+import pytest
+
+from stateright_tpu import StateRecorder, WriteReporter
+from stateright_tpu.models import LinearEquation, Panicker
+
+
+def test_visits_states_in_dfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_dfs().join()
+    # Successors push X-result then Y-result; LIFO pops Y first, so DFS dives
+    # down the y axis until (0, 27) solves (10*27) % 256 == 14.
+    assert accessor() == [(0, y) for y in range(28)]
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.discovery("solvable").into_actions() == ["IncreaseY"] * 27
+
+
+def test_report_format():
+    out = io.StringIO()
+    LinearEquation(2, 10, 14).checker().spawn_dfs().report(WriteReporter(out))
+    text = out.getvalue()
+    assert text.startswith(
+        "Checking. states=1, unique=1, depth=0\n"
+        "Done. states=55, unique=55, depth=28, sec="
+    )
+    assert 'Discovered "solvable" example Path[27]:' in text
+
+
+def test_handles_panics_gracefully():
+    with pytest.raises(RuntimeError, match="reached panic state"):
+        Panicker().checker().spawn_dfs().join()
+
+
+def test_full_enumeration_matches_bfs():
+    dfs = LinearEquation(2, 4, 7).checker().spawn_dfs().join()
+    assert dfs.is_done()
+    dfs.assert_no_discovery("solvable")
+    assert dfs.unique_state_count() == 256 * 256

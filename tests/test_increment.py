@@ -1,0 +1,61 @@
+"""Increment race goldens (reference: examples/increment.rs doc comment:
+13 unique states at 2 threads, 8 with symmetry reduction; the "fin"
+invariant has a counterexample)."""
+
+from stateright_tpu import Property, TensorModelAdapter
+from stateright_tpu.models import Increment, IncrementTensor
+from stateright_tpu.tensor import TensorProperty
+
+
+class IncrementFull(Increment):
+    """Increment plus an unsatisfiable sometimes-property.
+
+    Once every property has a discovery, the engines drain the queue without
+    expanding (reference bfs.rs:278-280) — so the full 13/8-state spaces from
+    the reference's doc comment are only observable when at least one
+    property stays undiscovered. The impossible property forces exhaustion.
+    """
+
+    def properties(self):
+        return super().properties() + [
+            Property.sometimes("unreachable", lambda _m, _s: False)
+        ]
+
+
+class IncrementTensorFull(IncrementTensor):
+    def tensor_properties(self):
+        return super().tensor_properties() + [
+            TensorProperty.sometimes(
+                "unreachable", lambda xp, states: xp.zeros(states.shape[0], dtype=bool)
+            )
+        ]
+
+
+def test_race_found_and_state_count():
+    checker = IncrementFull(2).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 13
+    path = checker.discovery("fin")
+    assert path is not None  # the lost-update interleaving exists
+    # Classic schedule: both threads read 0, then both write 1.
+    final = path.last_state()
+    assert final.i != sum(1 for (_t, pc) in final.s if pc == 3)
+
+
+def test_symmetry_reduction_golden():
+    checker = IncrementFull(2).checker().symmetry().spawn_dfs().join()
+    assert checker.unique_state_count() == 8
+    assert checker.discovery("fin") is not None
+
+
+def test_tensor_model_matches_host():
+    host = IncrementFull(2).checker().spawn_bfs().join()
+    tensor = TensorModelAdapter(IncrementTensorFull(2)).checker().spawn_bfs().join()
+    assert tensor.unique_state_count() == host.unique_state_count() == 13
+    assert tensor.discovery("fin") is not None
+
+
+def test_three_threads():
+    host = IncrementFull(3).checker().spawn_bfs().join()
+    tensor = TensorModelAdapter(IncrementTensorFull(3)).checker().spawn_bfs().join()
+    assert host.unique_state_count() == tensor.unique_state_count()
+    assert host.discovery("fin") is not None

@@ -1,0 +1,38 @@
+"""Two-phase commit integration goldens (reference: examples/2pc.rs:149-170)
+plus host-model/tensor-model equivalence."""
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseSys, TwoPhaseTensor
+
+
+def test_bfs_3_rms_golden():
+    checker = TwoPhaseSys(3).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+
+
+def test_dfs_5_rms_golden():
+    checker = TwoPhaseSys(5).checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+
+
+def test_dfs_5_rms_symmetry_golden():
+    checker = TwoPhaseSys(5).checker().symmetry().spawn_dfs().join()
+    assert checker.unique_state_count() == 665
+    checker.assert_properties()
+
+
+def test_tensor_model_matches_host_model():
+    # The dense tensor encoding explores the same state space as the rich
+    # host model: identical unique-state counts and property verdicts.
+    host = TwoPhaseSys(3).checker().spawn_bfs().join()
+    tensor = TensorModelAdapter(TwoPhaseTensor(3)).checker().spawn_bfs().join()
+    assert tensor.unique_state_count() == host.unique_state_count() == 288
+    tensor.assert_properties()
+
+
+def test_tensor_model_5_rms():
+    tensor = TensorModelAdapter(TwoPhaseTensor(5)).checker().spawn_dfs().join()
+    assert tensor.unique_state_count() == 8832
+    tensor.assert_properties()
