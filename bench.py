@@ -1,0 +1,75 @@
+"""Benchmark: batched frontier engine vs the host (Python) reference checker.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: exhaustive check of the two-phase-commit tensor model (the
+reference's own benchmark family, bench.sh:27-34 runs `2pc check N`).
+The device engine enumerates 2pc-7; the host oracle (the same TensorModel
+through the numpy adapter + host BFS, semantics identical to the reference
+engine) is timed on 2pc-5 and its states/sec rate is the baseline.
+`vs_baseline` is the speedup of the device engine over the host engine in
+states/sec.
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import os
+
+    import jax
+
+    # Honor an explicit JAX_PLATFORMS from the caller even when a boot-time
+    # sitecustomize pinned a different platform (needed for CPU smoke runs).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_compilation_cache_dir", "/tmp/srtpu_jax_cache")
+
+    from stateright_tpu import TensorModelAdapter
+    from stateright_tpu.models import TwoPhaseTensor
+
+    # --- host baseline: 2pc-5 (8,832 states) -----------------------------
+    t0 = time.perf_counter()
+    host = TensorModelAdapter(TwoPhaseTensor(5)).checker().spawn_bfs().join()
+    host_secs = time.perf_counter() - t0
+    host_states = host.state_count()
+    host_rate = host_states / host_secs
+
+    # --- device engine: 2pc-7 (larger space to amortize dispatch) --------
+    tm = TwoPhaseTensor(7)
+    engine_opts = dict(
+        chunk_size=8192, queue_capacity=1 << 19, table_capacity=1 << 21
+    )
+    # Warm-up/compile with the SAME TensorModel instance so the cached step
+    # function (and XLA executable) is reused by the timed run.
+    TensorModelAdapter(tm).checker().target_state_count(1).spawn_tpu_bfs(
+        **engine_opts
+    ).join()
+
+    t0 = time.perf_counter()
+    dev = TensorModelAdapter(tm).checker().spawn_tpu_bfs(**engine_opts).join()
+    dev_secs = time.perf_counter() - t0
+    dev_states = dev.state_count()
+    dev_rate = dev_states / dev_secs
+
+    result = {
+        "metric": "2pc-7 exhaustive check, generated states/sec (device engine)",
+        "value": round(dev_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(dev_rate / host_rate, 2),
+        "detail": {
+            "device_states": dev_states,
+            "device_unique": dev.unique_state_count(),
+            "device_secs": round(dev_secs, 3),
+            "host_states": host_states,
+            "host_secs": round(host_secs, 3),
+            "host_rate": round(host_rate, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

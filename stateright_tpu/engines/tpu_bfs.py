@@ -1,0 +1,397 @@
+"""The TPU-native batched BFS engine.
+
+This is the framework's reason to exist (SURVEY.md §7, BASELINE.json north
+star): the reference's per-thread hot loop — pop a state, evaluate
+properties, enumerate actions, fingerprint successors, dedup against a
+concurrent map (src/checker/bfs.rs:196-334) — re-designed as a data-parallel
+frontier program:
+
+  - the pending queue is a device-resident ring buffer of fixed-width
+    uint32 state rows (+ per-row eventually-bits and depth),
+  - each step pops a CHUNK of rows and runs one fused XLA program:
+    batched property evaluation, batched successor generation
+    (`TensorModel.step_batch`), vectorized 64-bit fingerprinting,
+    sort-based in-batch dedup, scatter-claim insertion into the
+    open-addressing visited table, stable compaction, and ring append,
+  - the host thread only orchestrates: it reads a few scalars per step
+    (new/generated counts, discovery flags), applies finish policies,
+    grows the hash table, and spills/refills the queue if it overflows.
+
+Semantics match the reference engine state-for-state (same property
+timing, terminal rule, eventually-bit propagation, boundary filtering,
+depth accounting); only scheduling order differs (level-synchronous
+instead of a work-stealing interleave — the same freedom the reference's
+multithreaded mode already has). Parent fingerprints stored in the table
+drive the same TLC-style path reconstruction (bfs.rs:380-409).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..checker import CheckerBuilder
+from ..core import Expectation
+from ..fingerprint import combine64, split64
+from ..path import Path
+from ..tensor import TensorModel, TensorModelAdapter
+from .common import HostEngineBase
+
+
+# Step cache: (id(tm), chunk) -> (tm ref, jitted step). Reusing the same
+# function object across checker instances is what lets JAX's trace cache
+# and the persistent compilation cache actually hit (a fresh closure per
+# checker would recompile every run).
+_STEP_CACHE: Dict[Tuple[int, int], Tuple[TensorModel, Any]] = {}
+
+
+def _build_step(tm: TensorModel, props, chunk: int):
+    """Compile the per-chunk BFS step for a given model and chunk size.
+
+    Returns a jitted function:
+      (table, queue, q_ebits, q_depth, head, count, depth_limit) ->
+      (table, queue, q_ebits, q_depth,
+       generated, new_count, unresolved, max_depth_seen,
+       prop_found[P], prop_fp1[P], prop_fp2[P])
+    """
+    cached = _STEP_CACHE.get((id(tm), chunk))
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import frontier as fr
+    from ..ops import visited_set as vs
+    from ..ops.expand import build_eval_and_expand
+
+    A = tm.max_actions
+    eval_and_expand = build_eval_and_expand(tm, props, chunk)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(table, queue, q_ebits, q_depth, head, count, depth_limit):
+        u = jnp.uint32
+        qcap = queue.shape[0]
+        qmask = u(qcap - 1)
+        take = jnp.minimum(count, u(chunk))
+        active = jnp.arange(chunk, dtype=jnp.uint32) < take
+        rows, slots = fr.ring_gather(queue, head, chunk)
+        ebits = q_ebits[slots]
+        depth = q_depth[slots]
+
+        ex = eval_and_expand(rows, ebits, depth, active, depth_limit)
+
+        keep = fr.dedup_mask(ex.h1, ex.h2, ex.valid)
+        table, is_new, unresolved = vs.insert(
+            table, ex.h1, ex.h2, ex.parent1, ex.parent2, keep
+        )
+
+        order, new_count = fr.compact_indices(is_new)
+        slot_valid = jnp.arange(chunk * A, dtype=jnp.uint32) < new_count
+        tail = (head + count) & qmask
+        queue = fr.ring_scatter(queue, tail, ex.flat[order], slot_valid)
+        q_ebits = fr.ring_scatter(
+            q_ebits[:, None], tail, ex.child_ebits[order][:, None], slot_valid
+        )[:, 0]
+        q_depth = fr.ring_scatter(
+            q_depth[:, None], tail, ex.child_depth[order][:, None], slot_valid
+        )[:, 0]
+
+        return (
+            table,
+            queue,
+            q_ebits,
+            q_depth,
+            ex.generated,
+            new_count,
+            unresolved.sum(dtype=jnp.uint32),
+            ex.max_depth_seen,
+            ex.prop_found,
+            ex.prop_fp1,
+            ex.prop_fp2,
+        )
+
+    _STEP_CACHE[(id(tm), chunk)] = (tm, step)
+    return step
+
+
+class TpuBfsChecker(HostEngineBase):
+    """Batched BFS over a TensorModel on the default JAX device."""
+
+    def __init__(
+        self,
+        builder: CheckerBuilder,
+        *,
+        chunk_size: int = 4096,
+        queue_capacity: int = 1 << 17,
+        table_capacity: int = 1 << 20,
+    ):
+        model = builder.model
+        if isinstance(model, TensorModel):
+            model = TensorModelAdapter(model)
+            builder.model = model
+        if not isinstance(model, TensorModelAdapter):
+            raise TypeError(
+                "spawn_tpu_bfs requires a TensorModel (or its adapter); "
+                "rich host models must be encoded first — see stateright_tpu.tensor."
+            )
+        super().__init__(builder)
+        if self._visitor is not None:
+            raise ValueError("the TPU engine does not support visitors")
+        # Like the reference's BFS, symmetry reduction is a DFS-only feature
+        # and is ignored here (bfs.rs never reads options.symmetry).
+
+        self.tm: TensorModel = model.tm
+        self._tprops = self.tm.tensor_properties()
+        n_event = sum(
+            1 for p in self._tprops if p.expectation == Expectation.EVENTUALLY
+        )
+        if n_event > 32:
+            raise ValueError("at most 32 eventually-properties supported")
+        if queue_capacity & (queue_capacity - 1):
+            raise ValueError("queue_capacity must be a power of two")
+        # qcap >= 2*C*A guarantees (a) the ring append never wraps over
+        # unconsumed rows while count <= high_water and (b) a spill block
+        # (<= C*A rows) always fits during refill, so spilled states are
+        # never stranded.
+        self._chunk = min(
+            chunk_size, queue_capacity // (2 * max(1, self.tm.max_actions))
+        )
+        if self._chunk == 0:
+            raise ValueError("queue_capacity too small for this model's fanout")
+        self._qcap = queue_capacity
+        self._tcap = table_capacity
+        self._step = _build_step(self.tm, self._tprops, self._chunk)
+
+        # Host-side bookkeeping.
+        self._unique = 0
+        self._discovery_fps: Dict[str, int] = {}
+        self._spill: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+
+        self._init_ebits_tensor = 0
+        e = 0
+        for p in self._tprops:
+            if p.expectation == Expectation.EVENTUALLY:
+                self._init_ebits_tensor |= 1 << e
+                e += 1
+
+        self._start()
+
+    # -- engine body --------------------------------------------------------
+
+    def _run(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..fingerprint import hash_words_np
+        from ..ops import frontier as fr
+        from ..ops import visited_set as vs
+
+        tm = self.tm
+        S = tm.state_width
+        A = tm.max_actions
+        C = self._chunk
+
+        inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
+        inb = np.asarray(tm.within_boundary_batch(np, inits), dtype=bool)
+        inits = inits[inb]
+        n_init = len(inits)
+        self._state_count = n_init
+        if n_init == 0:
+            return
+        if n_init > self._qcap:
+            raise ValueError("more initial states than queue capacity")
+
+        # Seed the table with init fingerprints (parent sentinel (0,0)).
+        table = vs.empty_table(self._tcap)
+        h1, h2 = hash_words_np(inits)
+        zero = jnp.zeros(n_init, dtype=jnp.uint32)
+        keep = fr.dedup_mask(jnp.asarray(h1), jnp.asarray(h2), jnp.ones(n_init, bool))
+        table, is_new, unresolved = vs.insert(
+            table, jnp.asarray(h1), jnp.asarray(h2), zero, zero, keep
+        )
+        assert int(unresolved.sum()) == 0
+        self._unique = int(is_new.sum())
+
+        # Queue: all init rows (dups included, reference bfs.rs:76-82).
+        queue = jnp.zeros((self._qcap, S), dtype=jnp.uint32)
+        queue = queue.at[:n_init].set(jnp.asarray(inits))
+        q_ebits = jnp.full(
+            self._qcap, self._init_ebits_tensor, dtype=jnp.uint32
+        )
+        q_depth = jnp.ones(self._qcap, dtype=jnp.uint32)
+        head = 0
+        count = n_init
+
+        depth_limit = (
+            self._target_max_depth
+            if self._target_max_depth is not None
+            else 0xFFFFFFFF
+        )
+        high_water = self._qcap - C * A
+
+        while count > 0 or self._spill:
+            # Refill from host spill, leaving room for the worst-case append
+            # (count must stay <= high_water going into the step, or the ring
+            # append could wrap over unconsumed frontier rows).
+            while self._spill and count + len(self._spill[-1][0]) <= high_water:
+                rows, ebs, dps = self._spill.pop()
+                k = len(rows)
+                tail_idx = (head + count + np.arange(k)) & (self._qcap - 1)
+                queue = queue.at[jnp.asarray(tail_idx)].set(jnp.asarray(rows))
+                q_ebits = q_ebits.at[jnp.asarray(tail_idx)].set(jnp.asarray(ebs))
+                q_depth = q_depth.at[jnp.asarray(tail_idx)].set(jnp.asarray(dps))
+                count += k
+            if count == 0:
+                break
+
+            # Proactive growth: guarantee the worst-case insert batch keeps
+            # the load factor <= ~0.5, so probe budgets can't be exhausted
+            # (exhaustion would silently drop states).
+            while self._unique + C * A > 0.45 * self._tcap:
+                table, self._tcap = self._grow_table(table)
+
+            (
+                table,
+                queue,
+                q_ebits,
+                q_depth,
+                generated,
+                new_count,
+                unresolved,
+                max_depth_seen,
+                prop_found,
+                prop_fp1,
+                prop_fp2,
+            ) = self._step(
+                table,
+                queue,
+                q_ebits,
+                q_depth,
+                jnp.uint32(head),
+                jnp.uint32(count),
+                jnp.uint32(depth_limit),
+            )
+
+            processed = min(count, C)
+            generated = int(generated)
+            new_count = int(new_count)
+            if int(unresolved) != 0:
+                # Cannot happen with the proactive growth above short of a
+                # pathological probe sequence; losing states would be an
+                # unsound "verified", so fail loudly.
+                raise RuntimeError(
+                    "visited-table probe budget exhausted despite headroom"
+                )
+            head = (head + processed) & (self._qcap - 1)
+            count = count - processed + new_count
+            self._state_count += generated
+            self._unique += new_count
+            self._max_depth = max(self._max_depth, int(max_depth_seen))
+
+            # Record first discovery per property (reference races are
+            # benign; ours are deterministic).
+            if len(self._tprops):
+                found = np.asarray(prop_found)
+                fp1 = np.asarray(prop_fp1)
+                fp2 = np.asarray(prop_fp2)
+                for i, p in enumerate(self._tprops):
+                    if found[i] and p.name not in self._discovery_fps:
+                        self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
+
+            # Spill if the next chunk could overflow the ring.
+            while count > high_water:
+                k = min(C * A, count - high_water)
+                take_idx = (head + count - k + np.arange(k)) & (self._qcap - 1)
+                idxs = jnp.asarray(take_idx)
+                self._spill.append(
+                    (
+                        np.asarray(queue[idxs]),
+                        np.asarray(q_ebits[idxs]),
+                        np.asarray(q_depth[idxs]),
+                    )
+                )
+                count -= k
+
+            if self._finish_matched(self._discovery_fps):
+                break
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                break
+            if self._timed_out():
+                break
+
+        self._table = np.asarray(table)  # retained for path reconstruction
+        return
+
+    def _grow_table(self, table):
+        """Double capacity and rehash every occupied row, chunked."""
+        import jax.numpy as jnp
+
+        from ..ops import visited_set as vs
+
+        old = np.asarray(table)
+        rows = old[np.asarray(vs.occupied_rows(old))]
+        new_cap = self._tcap * 2
+        new_table = vs.empty_table(new_cap)
+        B = 1 << 16
+        for i in range(0, len(rows), B):
+            blk = rows[i : i + B]
+            n = len(blk)
+            new_table, _is_new, unres = vs.insert(
+                new_table,
+                jnp.asarray(blk[:, 0]),
+                jnp.asarray(blk[:, 1]),
+                jnp.asarray(blk[:, 2]),
+                jnp.asarray(blk[:, 3]),
+                jnp.ones(n, dtype=bool),
+            )
+            if int(unres.sum()) != 0:
+                raise RuntimeError("rehash failed; table pathologically full")
+        return new_table, new_cap
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return self._unique
+
+    def discoveries(self) -> Dict[str, Path]:
+        self.join()
+        return {
+            name: self._reconstruct(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }
+
+    def _reconstruct(self, fp64: int) -> Path:
+        """Walk device-table parent pointers, then re-execute the model
+        along the fingerprint chain (reference bfs.rs:380-409)."""
+        import jax.numpy as jnp
+
+        from ..ops import visited_set as vs
+
+        table = jnp.asarray(self._table)
+        chain = [fp64]
+        cur = fp64
+        for _ in range(10_000_000):
+            h1, h2 = split64(cur)
+            found, p1, p2 = vs.lookup_parent(
+                table,
+                jnp.asarray([h1], dtype=jnp.uint32),
+                jnp.asarray([h2], dtype=jnp.uint32),
+            )
+            if not bool(found[0]):
+                raise RuntimeError(
+                    f"fingerprint {cur} missing from visited table during "
+                    "path reconstruction"
+                )
+            p1, p2 = int(p1[0]), int(p2[0])
+            if p1 == 0 and p2 == 0:
+                break
+            cur = combine64(p1, p2)
+            chain.append(cur)
+        chain.reverse()
+        return Path.from_fingerprints(self._model, chain)

@@ -1,0 +1,10 @@
+"""Device-side kernels for the batched frontier engine.
+
+The TPU-native replacements for the reference's concurrent data structures:
+the sharded DashMap visited set (src/checker/bfs.rs:29-30) becomes an
+open-addressing hash table in device memory with batched scatter-claim
+inserts (`visited_set`), and frontier bookkeeping (dedup, compaction, ring
+queue) becomes sort/scan array programs (`frontier`). Everything is uint32
+and jit-compatible so XLA can fuse the whole BFS level into a handful of
+kernels.
+"""

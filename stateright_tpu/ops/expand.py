@@ -1,0 +1,123 @@
+"""Shared per-chunk evaluate-and-expand core for the batched BFS engines.
+
+Both the single-device engine (engines/tpu_bfs.py) and the sharded engine
+(parallel/mesh.py) are required to be state-for-state equivalent to the
+reference checker's hot loop (src/checker/bfs.rs:196-334); they share this
+builder so the semantics live in exactly one place. The engines differ only
+in what happens *after* expansion: the single-device engine inserts locally,
+the sharded engine first exchanges candidates across the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from ..core import Expectation
+
+
+class Expanded(NamedTuple):
+    ebits: object  # [C] uint32, post property evaluation
+    flat: object  # [C*A, S] candidate states
+    h1: object  # [C*A] candidate fingerprints
+    h2: object
+    parent1: object  # [C*A] parent fingerprints
+    parent2: object
+    child_ebits: object  # [C*A]
+    child_depth: object  # [C*A]
+    valid: object  # [C*A] bool: action valid & in boundary & parent live
+    generated: object  # scalar uint32: number of valid candidates
+    max_depth_seen: object  # scalar uint32
+    prop_found: object  # [P] bool
+    prop_fp1: object  # [P] uint32
+    prop_fp2: object  # [P] uint32
+
+
+def build_eval_and_expand(tm, props, chunk: int):
+    """Returns f(rows, ebits, depth, active, depth_limit) -> Expanded.
+
+    Implements, batched: property evaluation with eventually-bit clearing
+    (bfs.rs:231-277), depth limiting (bfs.rs:219-224), successor generation
+    with boundary filtering, the terminal rule (no successor passed the
+    boundary, dups included — bfs.rs:283-333), and terminal eventually-bit
+    discoveries (bfs.rs:326-333).
+    """
+    import jax.numpy as jnp
+
+    from ..fingerprint import hash_words_jnp
+
+    S = tm.state_width
+    A = tm.max_actions
+
+    def eval_and_expand(rows, ebits, depth, active, depth_limit):
+        u = jnp.uint32
+        max_depth_seen = jnp.max(jnp.where(active, depth, u(0)))
+        # Depth-limited rows are popped but neither evaluated nor expanded.
+        live = active & (depth < depth_limit)
+        row_h1, row_h2 = hash_words_jnp(rows)
+
+        prop_found = []
+        prop_fp1 = []
+        prop_fp2 = []
+        e_idx = 0
+        e_slot = {}
+        for i, p in enumerate(props):
+            if p.expectation == Expectation.EVENTUALLY:
+                vals = p.check(jnp, rows) & live
+                ebits = jnp.where(vals, ebits & ~u(1 << e_idx), ebits)
+                e_slot[i] = e_idx
+                e_idx += 1
+                prop_found.append(None)  # filled in after terminal rule
+                prop_fp1.append(None)
+                prop_fp2.append(None)
+                continue
+            if p.expectation == Expectation.ALWAYS:
+                hits = live & ~p.check(jnp, rows)
+            else:  # SOMETIMES
+                hits = live & p.check(jnp, rows)
+            sel = jnp.argmax(hits)
+            prop_found.append(jnp.any(hits))
+            prop_fp1.append(row_h1[sel])
+            prop_fp2.append(row_h2[sel])
+
+        succs, amask = tm.step_batch(jnp, rows)  # [C, A, S], [C, A]
+        amask = amask & live[:, None]
+        flat = succs.reshape(chunk * A, S)
+        inb = tm.within_boundary_batch(jnp, flat).reshape(chunk, A)
+        valid = amask & inb
+        generated = valid.sum(dtype=jnp.uint32)
+
+        terminal = live & ~jnp.any(valid, axis=1)
+        for i, p in enumerate(props):
+            if p.expectation != Expectation.EVENTUALLY:
+                continue
+            bit = u(1 << e_slot[i])
+            fails = terminal & ((ebits & bit) != 0)
+            sel = jnp.argmax(fails)
+            prop_found[i] = jnp.any(fails)
+            prop_fp1[i] = row_h1[sel]
+            prop_fp2[i] = row_h2[sel]
+
+        h1, h2 = hash_words_jnp(flat)
+        n_props = len(props)
+        return Expanded(
+            ebits=ebits,
+            flat=flat,
+            h1=h1,
+            h2=h2,
+            parent1=jnp.repeat(row_h1, A),
+            parent2=jnp.repeat(row_h2, A),
+            child_ebits=jnp.repeat(ebits, A),
+            child_depth=jnp.repeat(depth + u(1), A),
+            valid=valid.reshape(chunk * A),
+            generated=generated,
+            max_depth_seen=max_depth_seen,
+            prop_found=jnp.stack(prop_found) if n_props else jnp.zeros(0, bool),
+            prop_fp1=(
+                jnp.stack(prop_fp1) if n_props else jnp.zeros(0, jnp.uint32)
+            ),
+            prop_fp2=(
+                jnp.stack(prop_fp2) if n_props else jnp.zeros(0, jnp.uint32)
+            ),
+        )
+
+    return eval_and_expand

@@ -1,0 +1,63 @@
+"""Frontier array programs: in-batch dedup, masked compaction, ring queue.
+
+These are the TPU-shaped replacements for the reference's per-thread
+VecDeque pending queues and entry-API dedup (src/checker/bfs.rs:177-335):
+ragged per-state successor sets become fixed-shape candidate batches that
+are deduplicated by sort, filtered by a visited-set insert, compacted by
+stable argsort, and appended to a power-of-two ring buffer that lives in
+device memory.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def dedup_mask(h1, h2, valid):
+    """First-occurrence mask over (h1, h2) keys, restricted to `valid`.
+
+    Sort-based: a lexsort with validity as the primary key pushes invalid
+    rows to the end; equal valid neighbors are duplicates. Which duplicate
+    survives is arbitrary-but-deterministic, matching the reference's
+    benign insert races (bfs.rs:243-244, 302-315).
+    """
+    invalid = (~valid).astype(jnp.uint8)
+    perm = jnp.lexsort((h2, h1, invalid))  # last key is primary
+    sv = valid[perm]
+    s1 = h1[perm]
+    s2 = h2[perm]
+    dup = (s1[1:] == s1[:-1]) & (s2[1:] == s2[:-1]) & sv[1:] & sv[:-1]
+    first = jnp.ones(h1.shape[0], dtype=bool).at[1:].set(~dup)
+    return jnp.zeros(h1.shape[0], dtype=bool).at[perm].set(first & sv)
+
+
+def compact_indices(keep):
+    """Stable indices of kept rows, packed to the front; count of kept.
+
+    Returns (indices[N], count) where indices[:count] are the positions of
+    kept rows in order and the tail repeats the last kept index (callers
+    mask by count).
+    """
+    order = jnp.argsort(~keep, stable=True)
+    count = keep.sum(dtype=jnp.uint32)
+    return order, count
+
+
+def ring_gather(queue, head, n):
+    """Gather `n` rows starting at `head` from a power-of-two ring buffer."""
+    cap = queue.shape[0]
+    idx = (head + jnp.arange(n, dtype=jnp.uint32)) & jnp.uint32(cap - 1)
+    return queue[idx], idx
+
+
+def ring_scatter(queue, tail, rows, valid):
+    """Append rows where `valid` at positions tail..tail+count in ring order.
+
+    `rows` must already be compacted (valid rows first); returns the updated
+    queue. Invalid rows scatter out of bounds and are dropped.
+    """
+    cap = queue.shape[0]
+    offsets = jnp.cumsum(valid.astype(jnp.uint32)) - 1
+    idx = (tail + offsets) & jnp.uint32(cap - 1)
+    idx = jnp.where(valid, idx, cap)
+    return queue.at[idx].set(rows, mode="drop")

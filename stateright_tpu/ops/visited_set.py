@@ -1,0 +1,116 @@
+"""Device-resident visited set: batched open-addressing hash table.
+
+TPU-native replacement for the reference BFS's concurrent visited map
+(DashMap<Fingerprint, Option<Fingerprint>> at src/checker/bfs.rs:29-30).
+Fingerprints are (h1, h2) uint32 pairs (64-bit effective, nonzero as a
+pair); the table is a [capacity, 4] uint32 array holding
+(key_h1, key_h2, parent_h1, parent_h2) per slot, with the all-zero key
+meaning "empty" and parent (0, 0) meaning "no parent" (initial state) —
+mirroring the reference's Option<Fingerprint> parent pointers used for
+path reconstruction (bfs.rs:380-409).
+
+Batched insert uses scatter-claim rounds of linear probing:
+each probe round every pending candidate (1) reads its slot, (2) resolves
+hits, (3) scatters its full row into empty slots (XLA scatter applies each
+update row atomically — duplicate indices resolve to one complete row),
+(4) reads back to learn if it won the claim, and losers advance to the next
+slot. Candidates must be pre-deduplicated within the batch (see
+`frontier.dedup_sorted`) so two pending candidates never carry the same key.
+
+All shapes are static; capacity is a power of two; the probe loop is a
+`lax.fori_loop` so the whole insert compiles to one fused kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MAX_PROBES = 64  # generous for load factor <= 0.5 (expected probes ~2)
+
+
+def empty_table(capacity: int) -> jax.Array:
+    """[capacity, 4] uint32 zeros; capacity must be a power of two."""
+    if capacity & (capacity - 1):
+        raise ValueError("visited-set capacity must be a power of two")
+    return jnp.zeros((capacity, 4), dtype=jnp.uint32)
+
+
+def insert(table, h1, h2, p1, p2, active):
+    """Insert fingerprints (h1,h2) with parents (p1,p2) where `active`.
+
+    Returns (table, is_new, unresolved):
+      is_new[i]     — candidate i claimed a fresh slot (first visit).
+      unresolved[i] — probe budget exhausted (table too full); callers must
+                      grow + retry, otherwise states would be silently lost.
+
+    Candidates must have distinct keys among active entries.
+    """
+    capacity = table.shape[0]
+    mask = jnp.uint32(capacity - 1)
+    idx = h1 & mask
+    done = ~active
+    is_new = jnp.zeros_like(active)
+
+    def body(_r, carry):
+        table, idx, done, is_new = carry
+        row = table[idx]  # [N, 4] gather
+        slot_empty = (row[:, 0] == 0) & (row[:, 1] == 0)
+        slot_match = (row[:, 0] == h1) & (row[:, 1] == h2)
+        done = done | slot_match  # already visited
+        want = ~done & slot_empty
+        # Claim: scatter full rows into empty slots; inactive rows aim
+        # out-of-bounds and are dropped.
+        scatter_idx = jnp.where(want, idx, capacity)
+        updates = jnp.stack([h1, h2, p1, p2], axis=-1)
+        table = table.at[scatter_idx].set(updates, mode="drop")
+        row2 = table[idx]
+        won = want & (row2[:, 0] == h1) & (row2[:, 1] == h2)
+        is_new = is_new | won
+        done = done | won
+        idx = jnp.where(done, idx, (idx + 1) & mask)
+        return table, idx, done, is_new
+
+    table, idx, done, is_new = lax.fori_loop(
+        0, MAX_PROBES, body, (table, idx, done, is_new)
+    )
+    unresolved = active & ~done
+    return table, is_new, unresolved
+
+
+def lookup_parent(table, h1, h2):
+    """Probe for fingerprints; returns (found, parent_h1, parent_h2).
+
+    Used by host-side path reconstruction to walk parent chains.
+    """
+    capacity = table.shape[0]
+    mask = jnp.uint32(capacity - 1)
+    idx = h1 & mask
+    done = jnp.zeros(h1.shape, dtype=bool)
+    found = jnp.zeros(h1.shape, dtype=bool)
+    par1 = jnp.zeros_like(h1)
+    par2 = jnp.zeros_like(h2)
+
+    def body(_r, carry):
+        idx, done, found, par1, par2 = carry
+        row = table[idx]
+        slot_empty = (row[:, 0] == 0) & (row[:, 1] == 0)
+        slot_match = (row[:, 0] == h1) & (row[:, 1] == h2)
+        hit = ~done & slot_match
+        par1 = jnp.where(hit, row[:, 2], par1)
+        par2 = jnp.where(hit, row[:, 3], par2)
+        found = found | hit
+        done = done | slot_match | slot_empty  # empty slot ends the chain
+        idx = jnp.where(done, idx, (idx + 1) & mask)
+        return idx, done, found, par1, par2
+
+    _idx, _done, found, par1, par2 = lax.fori_loop(
+        0, MAX_PROBES, body, (idx, done, found, par1, par2)
+    )
+    return found, par1, par2
+
+
+def occupied_rows(table):
+    """Mask of nonempty slots — used when rehashing into a larger table."""
+    return (table[:, 0] != 0) | (table[:, 1] != 0)

@@ -1,0 +1,16 @@
+"""Multi-chip state-space exploration over a jax.sharding.Mesh.
+
+The reference scales with work-stealing OS threads over a shared-memory
+DashMap (src/job_market.rs, src/checker/bfs.rs:90-164). The TPU-native
+equivalent shards both the visited table and the frontier queue across the
+device mesh by fingerprint ownership (owner = h1 mod n_devices) and keeps
+every structure device-resident: each step, devices expand their local
+frontier slice, exchange candidate fingerprints over ICI (all_gather),
+keep the candidates they own, and insert into their local table shard.
+Load balance comes from the hash itself — fingerprints spread uniformly,
+the same property the reference's sharded DashMap relies on.
+"""
+
+from .mesh import ShardedBfs
+
+__all__ = ["ShardedBfs"]

@@ -1,0 +1,297 @@
+"""Sharded batched BFS: the multi-chip engine core.
+
+Design (SURVEY.md §7 step 4, §5 "distributed communication backend"):
+
+  - mesh axis "shards" over N devices,
+  - visited table: [N, cap_local, 4] sharded on dim 0 — each device owns
+    the fingerprints with h1 % N == its index,
+  - frontier queue: [N, qcap_local, S] ring buffers, one per device, holding
+    only states that device owns,
+  - per step (one `shard_map`-ped XLA program):
+      1. each device pops a chunk from its local ring and evaluates
+         properties on it (results returned per-device; host merges),
+      2. expands successors locally with the model's batched step,
+      3. `all_gather`s candidate (state, fingerprint, parent, ebits, depth)
+         tuples over the mesh axis — this is the ICI hop, the analogue of
+         the reference's cross-thread job market (src/job_market.rs),
+      4. keeps only candidates it owns, dedups in-batch, scatter-claims
+         into its local table shard, compacts, and appends to its ring.
+
+The all_gather exchange is simple and correct; a sorted all_to_all that
+routes each candidate only to its owner is the planned optimization (it
+cuts ICI traffic by ~N_devices x).
+
+Initial states are pre-routed to their owners on the host. Queue overflow
+raises (size the ring for the model; per-shard spill is future work).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import Expectation
+from ..fingerprint import combine64, hash_words_np
+from ..tensor import TensorModel
+
+
+def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis: str):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import frontier as fr
+    from ..ops import visited_set as vs
+    from ..ops.expand import build_eval_and_expand
+
+    A = tm.max_actions
+    eval_and_expand = build_eval_and_expand(tm, props, chunk)
+
+    def per_device(table, queue, q_ebits, q_depth, head, count, depth_limit):
+        # Local blocks arrive with a leading length-1 shard dim; drop it.
+        table = table[0]
+        queue = queue[0]
+        q_ebits = q_ebits[0]
+        q_depth = q_depth[0]
+        head = head[0]
+        count = count[0]
+        depth_limit = depth_limit[0]
+
+        u = jnp.uint32
+        me = lax.axis_index(axis).astype(jnp.uint32)
+        qcap = queue.shape[0]
+        qmask = u(qcap - 1)
+        take = jnp.minimum(count, u(chunk))
+        active = jnp.arange(chunk, dtype=jnp.uint32) < take
+        rows, slots = fr.ring_gather(queue, head, chunk)
+        ebits = q_ebits[slots]
+        depth = q_depth[slots]
+
+        ex = eval_and_expand(rows, ebits, depth, active, depth_limit)
+        generated = ex.generated
+        max_depth_seen = ex.max_depth_seen
+
+        # --- ICI exchange: gather all candidates, keep what I own -------
+        def gather(x):
+            return lax.all_gather(x, axis, tiled=True)
+
+        g_flat = gather(ex.flat)  # [Nshards*C*A, S]
+        g_h1 = gather(ex.h1)
+        g_h2 = gather(ex.h2)
+        g_p1 = gather(ex.parent1)
+        g_p2 = gather(ex.parent2)
+        g_ebits = gather(ex.child_ebits)
+        g_depth = gather(ex.child_depth)
+        g_valid = gather(ex.valid)
+
+        mine = g_valid & ((g_h1 % u(n_shards)) == me)
+        keep = fr.dedup_mask(g_h1, g_h2, mine)
+        table, is_new, unresolved = vs.insert(table, g_h1, g_h2, g_p1, g_p2, keep)
+
+        order, new_count = fr.compact_indices(is_new)
+        packed_rows = g_flat[order]
+        packed_ebits = g_ebits[order]
+        packed_depth = g_depth[order]
+        n_cand = g_h1.shape[0]
+        slot_valid = jnp.arange(n_cand, dtype=jnp.uint32) < new_count
+        tail = (head + count) & qmask
+        queue = fr.ring_scatter(queue, tail, packed_rows, slot_valid)
+        q_ebits = fr.ring_scatter(
+            q_ebits[:, None], tail, packed_ebits[:, None], slot_valid
+        )[:, 0]
+        q_depth = fr.ring_scatter(
+            q_depth[:, None], tail, packed_depth[:, None], slot_valid
+        )[:, 0]
+
+        head = (head + take) & qmask
+        count = count - take + new_count
+        overflow = count > u(qcap)
+
+        def exp(x):
+            return jnp.expand_dims(x, 0)
+
+        pf = ex.prop_found
+        p1 = ex.prop_fp1
+        p2 = ex.prop_fp2
+
+        return (
+            exp(table),
+            exp(queue),
+            exp(q_ebits),
+            exp(q_depth),
+            exp(head),
+            exp(count),
+            exp(generated),
+            exp(new_count),
+            exp(unresolved.sum(dtype=jnp.uint32)),
+            exp(max_depth_seen),
+            exp(overflow),
+            exp(pf),
+            exp(p1),
+            exp(p2),
+        )
+
+    return per_device
+
+
+class ShardedBfs:
+    """Host driver for the sharded batched BFS across a device mesh."""
+
+    def __init__(
+        self,
+        tm: TensorModel,
+        devices: Optional[List] = None,
+        *,
+        chunk_size: int = 1024,
+        queue_capacity_per_shard: int = 1 << 14,
+        table_capacity_per_shard: int = 1 << 16,
+        target_max_depth: Optional[int] = None,
+    ):
+        import jax
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        self.tm = tm
+        self._props = tm.tensor_properties()
+        devices = devices if devices is not None else jax.devices()
+        self.n_shards = len(devices)
+        self.mesh = Mesh(np.array(devices), ("shards",))
+        self._chunk = chunk_size
+        self._qcap = queue_capacity_per_shard
+        self._tcap = table_capacity_per_shard
+        self._target_max_depth = target_max_depth
+        if self._qcap & (self._qcap - 1) or self._tcap & (self._tcap - 1):
+            raise ValueError("capacities must be powers of two")
+
+        per_device = _build_sharded_step(
+            tm, self._props, chunk_size, self.n_shards, "shards"
+        )
+        spec = P("shards")
+        n_in = 7
+        n_out = 14
+        self._step = jax.jit(
+            shard_map(
+                per_device,
+                mesh=self.mesh,
+                in_specs=(spec,) * n_in,
+                out_specs=(spec,) * n_out,
+            ),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+        self.state_count = 0
+        self.unique_state_count = 0
+        self.max_depth = 0
+        self.discovery_fps: Dict[str, int] = {}
+
+    def run(self, max_steps: int = 1_000_000) -> "ShardedBfs":
+        import jax.numpy as jnp
+
+        tm = self.tm
+        N = self.n_shards
+        S = tm.state_width
+
+        inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
+        inb = np.asarray(tm.within_boundary_batch(np, inits), dtype=bool)
+        inits = inits[inb]
+        self.state_count = len(inits)
+        h1, h2 = hash_words_np(inits)
+
+        init_ebits = 0
+        e = 0
+        for p in self._props:
+            if p.expectation == Expectation.EVENTUALLY:
+                init_ebits |= 1 << e
+                e += 1
+
+        # Route init states to their owner shards; dedup via host set.
+        queue = np.zeros((N, self._qcap, S), dtype=np.uint32)
+        q_ebits = np.full((N, self._qcap), init_ebits, dtype=np.uint32)
+        q_depth = np.ones((N, self._qcap), dtype=np.uint32)
+        counts = np.zeros(N, dtype=np.uint32)
+        table = np.zeros((N, self._tcap, 4), dtype=np.uint32)
+        seen = set()
+        for i in range(len(inits)):
+            owner = int(h1[i]) % N
+            queue[owner, counts[owner]] = inits[i]
+            counts[owner] += 1
+            fp = combine64(h1[i], h2[i])
+            if fp not in seen:
+                seen.add(fp)
+                # Seed the owner's table directly (host-side, pre-run).
+                self._host_insert(table[owner], int(h1[i]), int(h2[i]))
+                self.unique_state_count += 1
+
+        table = jnp.asarray(table)
+        queue = jnp.asarray(queue)
+        q_ebits = jnp.asarray(q_ebits)
+        q_depth = jnp.asarray(q_depth)
+        head = jnp.zeros(N, dtype=jnp.uint32)
+        count = jnp.asarray(counts)
+        depth_limit = jnp.full(
+            N,
+            self._target_max_depth
+            if self._target_max_depth is not None
+            else 0xFFFFFFFF,
+            dtype=jnp.uint32,
+        )
+
+        for _ in range(max_steps):
+            if int(np.asarray(count).sum()) == 0:
+                break
+            (
+                table,
+                queue,
+                q_ebits,
+                q_depth,
+                head,
+                count,
+                generated,
+                new_count,
+                unresolved,
+                max_depth_seen,
+                overflow,
+                pf,
+                p1,
+                p2,
+            ) = self._step(table, queue, q_ebits, q_depth, head, count, depth_limit)
+            if bool(np.asarray(overflow).any()):
+                raise RuntimeError(
+                    "per-shard frontier ring overflow; increase "
+                    "queue_capacity_per_shard"
+                )
+            if int(np.asarray(unresolved).sum()) != 0:
+                raise RuntimeError(
+                    "visited-table probe budget exhausted; increase "
+                    "table_capacity_per_shard"
+                )
+            self.state_count += int(np.asarray(generated).sum())
+            self.unique_state_count += int(np.asarray(new_count).sum())
+            self.max_depth = max(self.max_depth, int(np.asarray(max_depth_seen).max()))
+            if self._props:
+                pf_np = np.asarray(pf)
+                p1_np = np.asarray(p1)
+                p2_np = np.asarray(p2)
+                for i, p in enumerate(self._props):
+                    if p.name in self.discovery_fps:
+                        continue
+                    hits = np.nonzero(pf_np[:, i])[0]
+                    if len(hits):
+                        d = hits[0]
+                        self.discovery_fps[p.name] = combine64(
+                            p1_np[d, i], p2_np[d, i]
+                        )
+        self._table = np.asarray(table)
+        return self
+
+    @staticmethod
+    def _host_insert(table_shard: np.ndarray, h1: int, h2: int) -> None:
+        cap = table_shard.shape[0]
+        idx = h1 & (cap - 1)
+        while table_shard[idx, 0] != 0 or table_shard[idx, 1] != 0:
+            if table_shard[idx, 0] == h1 and table_shard[idx, 1] == h2:
+                return
+            idx = (idx + 1) & (cap - 1)
+        table_shard[idx] = (h1, h2, 0, 0)
