@@ -29,12 +29,17 @@ from .has_discoveries import HasDiscoveries
 from .path import Path
 from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
 from .visitor import CheckerVisitor, PathRecorder, StateRecorder
+from .symmetry import Representative, RewritePlan
 from .tensor import TensorModel, TensorModelAdapter, TensorProperty
+from .utils import DenseNatMap, VectorClock
+from .engines.simulation import Chooser, UniformChooser
 
 __all__ = [
     "Checker",
     "CheckerBuilder",
     "CheckerVisitor",
+    "Chooser",
+    "DenseNatMap",
     "DiscoveryClassification",
     "Expectation",
     "HasDiscoveries",
@@ -42,6 +47,9 @@ __all__ = [
     "Path",
     "PathRecorder",
     "Property",
+    "Representative",
+    "RewritePlan",
+    "VectorClock",
     "ReportData",
     "ReportDiscovery",
     "Reporter",
@@ -49,6 +57,7 @@ __all__ = [
     "TensorModel",
     "TensorModelAdapter",
     "TensorProperty",
+    "UniformChooser",
     "WriteReporter",
     "fingerprint",
 ]

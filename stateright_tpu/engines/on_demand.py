@@ -1,0 +1,159 @@
+"""On-demand engine: a lazy BFS that expands only what it is asked to.
+
+Reference: src/checker/on_demand.rs. The engine seeds the frontier with the
+initial states and then idles. The Explorer (or any caller) drives it:
+
+  - `check_fingerprint(fp)` expands the pending frontier node with that
+    fingerprint (on_demand.rs:136-177, 406-411), growing the frontier by its
+    successors — so browsing the state space progressively materializes it;
+  - `run_to_completion()` switches to exhaustive BFS over whatever remains
+    (ControlFlow::RunToCompletion, checker.rs:33-36).
+
+The visited map stores parent pointers exactly like BFS, so discovery paths
+are reconstructed the same way. All entry points are serialized by a lock;
+`run_to_completion` runs in a background thread so HTTP handlers that trigger
+it stay responsive.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..checker import CheckerBuilder
+from ..path import Path
+from .common import BLOCK_SIZE, HostEngineBase
+
+
+class OnDemandChecker(HostEngineBase):
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        model = self._model
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        self._generated: Dict[int, Optional[int]] = {}
+        for s in init_states:
+            self._generated.setdefault(self._fp(s), None)
+        self._pending = deque(
+            (s, self._fp(s), self._init_ebits, 1) for s in init_states
+        )
+        self._discoveries: Dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._run_thread: Optional[threading.Thread] = None
+        self._initial_snapshot = (self._state_count, self.unique_state_count(), 0)
+
+    # -- lifecycle (idle until driven; no auto-started thread) ---------------
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return not self._pending or self._finish_matched(self._discoveries)
+
+    def join(self) -> "OnDemandChecker":
+        t = self._run_thread
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            raise self._error
+        return self
+
+    # -- control flow --------------------------------------------------------
+
+    def check_fingerprint(self, fingerprint: int) -> None:
+        """Expand the pending frontier node with this fingerprint, if any.
+
+        Reference: ControlFlow::CheckFingerprint handling, on_demand.rs:140-163.
+        """
+        with self._lock:
+            for i, job in enumerate(self._pending):
+                if job[1] == fingerprint:
+                    del self._pending[i]
+                    self._process_job(job)
+                    return
+
+    def run_to_completion(self) -> None:
+        """Exhaustively check everything still pending, in the background."""
+        with self._lock:
+            if self._run_thread is not None:
+                return
+            self._run_thread = threading.Thread(target=self._run_guarded, daemon=True)
+            self._run_thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                for _ in range(BLOCK_SIZE):
+                    if not self._pending:
+                        return
+                    self._process_job(self._pending.pop())
+                if self._finish_matched(self._discoveries):
+                    return
+                if (
+                    self._target_state_count is not None
+                    and self._state_count >= self._target_state_count
+                ):
+                    return
+            if self._timed_out():
+                return
+
+    # -- expansion (single job; mirrors on_demand.rs check_block body) -------
+
+    def _process_job(self, job) -> None:
+        model = self._model
+        generated = self._generated
+        discoveries = self._discoveries
+        state, state_fp, ebits, depth = job
+
+        if depth > self._max_depth:
+            self._max_depth = depth
+        if self._target_max_depth is not None and depth >= self._target_max_depth:
+            return
+        if self._visitor is not None:
+            self._visitor.visit(model, self._reconstruct_path(state_fp))
+
+        ebits, is_awaiting = self._check_properties(
+            state, ebits, discoveries, lambda: state_fp
+        )
+        if not is_awaiting:
+            return
+
+        is_terminal = True
+        actions: List[Any] = []
+        model.actions(state, actions)
+        for action in actions:
+            next_state = model.next_state(state, action)
+            if next_state is None:
+                continue
+            if not model.within_boundary(next_state):
+                continue
+            self._state_count += 1
+            next_fp = self._fp(next_state)
+            if next_fp in generated:
+                is_terminal = False
+                continue
+            generated[next_fp] = state_fp
+            is_terminal = False
+            self._pending.appendleft((next_state, next_fp, ebits, depth + 1))
+        if is_terminal:
+            self._terminal_ebit_discoveries(ebits, discoveries, lambda: state_fp)
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> Dict[str, Path]:
+        with self._lock:
+            return {
+                name: self._reconstruct_path(fp)
+                for name, fp in list(self._discoveries.items())
+            }
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        fingerprints: deque = deque()
+        next_fp: Optional[int] = fp
+        while next_fp is not None and next_fp in self._generated:
+            fingerprints.appendleft(next_fp)
+            next_fp = self._generated[next_fp]
+        return Path.from_fingerprints(self._model, list(fingerprints))

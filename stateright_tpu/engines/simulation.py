@@ -1,0 +1,174 @@
+"""Simulation engine: repeated seeded random walks from init to terminal.
+
+Reference: src/checker/simulation.rs. Each trace walks the model by letting a
+pluggable `Chooser` select an initial state and then an enabled action per
+step, until the walk terminates (no actions), loops back on itself (per-run
+cycle detection via a generated-fingerprint set, simulation.rs:285-289),
+leaves the boundary, or all properties have discoveries. Traces repeat with
+fresh derived seeds until `finish_when` matches, the target state count is
+reached, or the timeout fires.
+
+Discoveries store the full fingerprint path of the violating trace, so the
+reported counterexample is exactly the random walk that found it.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ..checker import CheckerBuilder
+from ..path import Path
+from .common import HostEngineBase
+
+
+class Chooser:
+    """Chooses transitions for simulation runs. Reference: simulation.rs:22-39.
+
+    One chooser instance is shared; `new_state(seed)` creates the per-trace
+    mutable state (e.g. an RNG).
+    """
+
+    def new_state(self, seed: int) -> Any:
+        raise NotImplementedError
+
+    def choose_initial_state(self, state: Any, initial_states: List[Any]) -> int:
+        raise NotImplementedError
+
+    def choose_action(self, state: Any, current_state: Any, actions: List[Any]) -> int:
+        raise NotImplementedError
+
+
+class UniformChooser(Chooser):
+    """Uniform random choices from a seeded, reproducible PRNG.
+
+    Reference: simulation.rs:42-79 (which notes its StdRng is not
+    version-stable; we use Python's Mersenne Twister, which is).
+    """
+
+    def new_state(self, seed: int) -> random.Random:
+        return random.Random(seed)
+
+    def choose_initial_state(self, rng: random.Random, initial_states: List[Any]) -> int:
+        return rng.randrange(len(initial_states))
+
+    def choose_action(self, rng: random.Random, current_state: Any, actions: List[Any]) -> int:
+        return rng.randrange(len(actions))
+
+
+class SimulationChecker(HostEngineBase):
+    """Reference: SimulationChecker::spawn, simulation.rs:95-211."""
+
+    def __init__(self, builder: CheckerBuilder, seed: int, chooser: Chooser):
+        super().__init__(builder)
+        self._seed = seed
+        self._chooser = chooser
+        self._discoveries: Dict[str, List[int]] = {}  # name -> fingerprint path
+        self._start()
+
+    # -- exploration --------------------------------------------------------
+
+    def _run(self) -> None:
+        # Per-thread seed evolution mirrors simulation.rs:154-197: the first
+        # trace uses the caller's seed for reproducibility; subsequent trace
+        # seeds are drawn from a thread RNG seeded with the same value.
+        seed = self._seed
+        thread_rng = random.Random(self._seed)
+        while True:
+            self._check_trace_from_initial(seed)
+            if self._finish_matched(self._discoveries):
+                return
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                return
+            if self._timed_out():
+                return
+            seed = thread_rng.getrandbits(64)
+
+    def _check_trace_from_initial(self, seed: int) -> None:
+        """One random walk. Mirrors simulation.rs:213-398."""
+        model = self._model
+        chooser = self._chooser
+        symmetry = self._symmetry
+        discoveries = self._discoveries
+
+        chooser_state = chooser.new_state(seed)
+        initial_states = model.init_states()
+        state = initial_states[
+            chooser.choose_initial_state(chooser_state, initial_states)
+        ]
+
+        fingerprint_path: List[int] = []
+        generated: set = set()  # per-run cycle detection
+        ebits = self._init_ebits
+        reached_max_depth = False
+
+        while True:
+            if len(fingerprint_path) > self._max_depth:
+                self._max_depth = len(fingerprint_path)
+            if (
+                self._target_max_depth is not None
+                and len(fingerprint_path) >= self._target_max_depth
+            ):
+                # Not known to be terminal: skip the final ebits check
+                # (simulation.rs:252-263 returns, not breaks).
+                reached_max_depth = True
+                break
+            if not model.within_boundary(state):
+                break
+
+            fp = self._fp(state)
+            fingerprint_path.append(fp)
+            key = self._fp(symmetry(state)) if symmetry is not None else fp
+            if key in generated:
+                break  # found a loop
+            generated.add(key)
+            self._state_count += 1
+
+            if self._visitor is not None:
+                self._visitor.visit(
+                    model, Path.from_fingerprints(model, fingerprint_path)
+                )
+
+            ebits, is_awaiting = self._check_properties(
+                state, ebits, discoveries, lambda: list(fingerprint_path)
+            )
+            if not is_awaiting:
+                break  # discoveries found for all properties
+
+            # Choose actions until one yields a next state (simulation.rs:355-390).
+            actions: List[Any] = []
+            model.actions(state, actions)
+            advanced = False
+            while actions:
+                index = chooser.choose_action(chooser_state, state, actions)
+                # swap_remove discipline, matching the reference's sampling
+                # without replacement.
+                actions[index], actions[-1] = actions[-1], actions[index]
+                action = actions.pop()
+                next_state = model.next_state(state, action)
+                if next_state is not None:
+                    state = next_state
+                    advanced = True
+                    break
+            if not advanced:
+                break  # terminal: no enabled action produced a state
+
+        if not reached_max_depth:
+            self._terminal_ebit_discoveries(
+                ebits, discoveries, lambda: list(fingerprint_path)
+            )
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        # No global visited set is kept (simulation.rs:413-417).
+        return self._state_count
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self._model, fps)
+            for name, fps in list(self._discoveries.items())
+        }

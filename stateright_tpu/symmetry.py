@@ -1,0 +1,111 @@
+"""Symmetry reduction: rewrite plans and equivalence-class representatives.
+
+Reference: src/checker/{representative,rewrite,rewrite_plan}.rs. A state's
+`representative()` returns a canonical member of its symmetry equivalence
+class (e.g. under permutation of process ids); the DFS and simulation engines
+insert representative fingerprints into the visited set so symmetric states
+are explored once (dfs.rs:309-318, simulation.rs:285-289).
+
+`RewritePlan` is the workhorse: built from the values whose sorted order
+defines the canonical permutation (`from_values_to_sort`,
+rewrite_plan.rs:77-106), it rewrites id-valued data recursively through
+containers (the role of the `Rewrite` blanket impls, rewrite.rs:18-163) and
+permutes id-indexed sequences via `reindex` (rewrite_plan.rs:108-124).
+
+Python adaptation: Rust drives rewriting by the static type `Rewrite<R>`;
+here the plan carries the id *type* (`domain`, e.g. `Id`) and rewriting
+walks values structurally — instances of the domain type are remapped,
+containers/dataclasses recurse, everything else passes through. Custom
+classes can implement `rewrite_with(plan)` to control their own rewriting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class Representative:
+    """Mixin/protocol: states that can produce a canonical representative.
+
+    Reference: representative.rs:65-68.
+    """
+
+    def representative(self) -> "Representative":
+        raise NotImplementedError
+
+
+class RewritePlan:
+    """A permutation of a dense id space, applied recursively to values.
+
+    `mapping[i]` is the new id for old id `i`.
+    """
+
+    __slots__ = ("domain", "mapping", "_inverse")
+
+    def __init__(self, domain: type, mapping: Sequence[int]):
+        if domain is int:
+            raise TypeError(
+                "RewritePlan domain must be a dedicated id type (e.g. Id), "
+                "not int: rewriting would remap every integer in the state."
+            )
+        self.domain = domain
+        self.mapping = list(mapping)
+        inv = [0] * len(self.mapping)
+        for old, new in enumerate(self.mapping):
+            inv[new] = old
+        self._inverse = inv
+
+    @staticmethod
+    def from_values_to_sort(domain: type, values: Sequence[Any]) -> "RewritePlan":
+        """Canonical permutation from sorting `values` (stable).
+
+        Old id i maps to the rank of values[i] in the sorted order —
+        mirroring rewrite_plan.rs:84-106.
+        """
+        order = sorted(range(len(values)), key=lambda i: values[i])
+        mapping = [0] * len(values)
+        for rank, old in enumerate(order):
+            mapping[old] = rank
+        return RewritePlan(domain, mapping)
+
+    # -- application ---------------------------------------------------------
+
+    def rewrite(self, x: Any) -> Any:
+        """Recursively rewrite domain-typed ids inside `x`."""
+        if isinstance(x, self.domain):
+            return self.domain(self.mapping[int(x)])
+        if hasattr(x, "rewrite_with"):
+            return x.rewrite_with(self)
+        if isinstance(x, tuple):
+            if hasattr(x, "_fields"):  # NamedTuple: preserve the type
+                return type(x)(*(self.rewrite(v) for v in x))
+            return tuple(self.rewrite(v) for v in x)
+        if isinstance(x, list):
+            return [self.rewrite(v) for v in x]
+        if isinstance(x, frozenset):
+            return frozenset(self.rewrite(v) for v in x)
+        if isinstance(x, set):
+            return {self.rewrite(v) for v in x}
+        if isinstance(x, dict):
+            return {self.rewrite(k): self.rewrite(v) for k, v in x.items()}
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            return dataclasses.replace(
+                x,
+                **{
+                    f.name: self.rewrite(getattr(x, f.name))
+                    for f in dataclasses.fields(x)
+                },
+            )
+        return x
+
+    def reindex(self, indexed: Sequence[Any]) -> List[Any]:
+        """Permute an id-indexed sequence into canonical order, rewriting
+        each element along the way. new[mapping[i]] = rewrite(old[i]).
+
+        Reference: rewrite_plan.rs:108-124.
+        """
+        return [self.rewrite(indexed[old]) for old in self._inverse]
+
+    def __repr__(self) -> str:
+        return f"RewritePlan(domain={self.domain.__name__}, mapping={self.mapping})"

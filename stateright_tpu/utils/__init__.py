@@ -1,0 +1,13 @@
+"""Utility data structures (reference: src/util.rs, src/util/*).
+
+Python's builtin set/dict already hash order-insensitively under this
+framework's canonical fingerprinting (stateright_tpu.fingerprint sorts
+element encodings, the same strategy as the reference's HashableHashSet /
+HashableHashMap, util.rs:137-159) — so no wrapper types are needed for
+model states; plain set/frozenset/dict are the idiomatic spelling.
+"""
+
+from .densenatmap import DenseNatMap
+from .vector_clock import VectorClock
+
+__all__ = ["DenseNatMap", "VectorClock"]

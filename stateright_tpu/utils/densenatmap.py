@@ -1,0 +1,121 @@
+"""DenseNatMap: a Vec-backed map for dense index-like keys.
+
+Reference: src/util/densenatmap.rs. Keys must convert to ints densely
+covering [0, len): inserting out of order raises, mirroring the reference's
+panic. The key type is remembered from the first insert so lookups with a
+different key family can be caught in tests (the reference enforces this
+statically with PhantomData).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+
+
+def _as_index(key: Any) -> int:
+    i = int(key)
+    if i < 0:
+        raise ValueError(f"DenseNatMap keys must be non-negative, got {i}")
+    return i
+
+
+class DenseNatMap:
+    __slots__ = ("_values", "_key_from_index")
+
+    def __init__(
+        self,
+        values: Iterable[Any] = (),
+        key_from_index: Optional[Callable[[int], Any]] = None,
+    ):
+        self._values: List[Any] = list(values)
+        # How to rebuild keys for iteration; defaults to plain ints.
+        self._key_from_index = key_from_index or (lambda i: i)
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_pairs(pairs: Iterable[Tuple[Any, Any]]) -> "DenseNatMap":
+        """Collect (key, value) pairs in any order; keys must be dense.
+
+        Reference: FromIterator, densenatmap.rs:64-71.
+        """
+        pairs = list(pairs)
+        out: List[Any] = [None] * len(pairs)
+        seen = [False] * len(pairs)
+        key_proto = None
+        for k, v in pairs:
+            i = _as_index(k)
+            if i >= len(out) or seen[i]:
+                raise ValueError(f"keys are not dense in [0, {len(out)}): {i}")
+            out[i] = v
+            seen[i] = True
+            key_proto = type(k)
+        kf = (
+            (lambda i: key_proto(i))
+            if key_proto is not None and key_proto is not int
+            else (lambda i: i)
+        )
+        return DenseNatMap(out, key_from_index=kf)
+
+    def insert(self, key: Any, value: Any) -> None:
+        """Insert in ascending key order; out-of-order insertion raises."""
+        i = _as_index(key)
+        if i != len(self._values):
+            raise ValueError(
+                f"DenseNatMap::insert out of order: expected key {len(self._values)}, got {i}"
+            )
+        self._values.append(value)
+        if type(key) is not int:
+            kp = type(key)
+            self._key_from_index = lambda i: kp(i)
+
+    # -- access --------------------------------------------------------------
+
+    def get(self, key: Any) -> Optional[Any]:
+        i = _as_index(key)
+        return self._values[i] if 0 <= i < len(self._values) else None
+
+    def __getitem__(self, key: Any) -> Any:
+        return self._values[_as_index(key)]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        i = _as_index(key)
+        if i == len(self._values):
+            self.insert(key, value)
+        else:
+            self._values[i] = value
+
+    def values(self) -> List[Any]:
+        return list(self._values)
+
+    def keys(self) -> List[Any]:
+        return [self._key_from_index(i) for i in range(len(self._values))]
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        for i, v in enumerate(self._values):
+            yield self._key_from_index(i), v
+
+    def __iter__(self) -> Iterator[Tuple[Any, Any]]:
+        return self.items()
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __contains__(self, key: Any) -> bool:
+        return 0 <= int(key) < len(self._values)
+
+    # -- equality / fingerprinting -------------------------------------------
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, DenseNatMap):
+            return NotImplemented
+        return self._values == other._values
+
+    def __hash__(self) -> int:
+        return hash(tuple(self._values))
+
+    def fingerprint_key(self) -> list:
+        return self._values
+
+    def __repr__(self) -> str:
+        return f"DenseNatMap({self._values!r})"

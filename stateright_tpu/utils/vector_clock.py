@@ -1,0 +1,103 @@
+"""Vector clocks: a partial causal order on distributed events.
+
+Reference: src/util/vector_clock.rs. Semantics preserved exactly:
+equality/hash/fingerprint ignore trailing zeros (a clock is conceptually
+infinite-dimensional with zero defaults), `merge_max` takes elementwise
+maxima, `incremented` grows the vector on demand, and `partial_cmp` returns
+None for causally concurrent (incomparable) clocks.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class VectorClock:
+    __slots__ = ("_v",)
+
+    def __init__(self, components: Sequence[int] = ()):
+        self._v: List[int] = list(components)
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def merge_max(c1: "VectorClock", c2: "VectorClock") -> "VectorClock":
+        """Elementwise maximum. Reference: vector_clock.rs:18-30."""
+        n = max(len(c1._v), len(c2._v))
+        return VectorClock(
+            [
+                max(
+                    c1._v[i] if i < len(c1._v) else 0,
+                    c2._v[i] if i < len(c2._v) else 0,
+                )
+                for i in range(n)
+            ]
+        )
+
+    def incremented(self, index: int) -> "VectorClock":
+        """A copy with component `index` incremented (growing as needed).
+
+        Reference: vector_clock.rs:32-39.
+        """
+        v = list(self._v)
+        if index >= len(v):
+            v.extend([0] * (1 + index - len(v)))
+        v[index] += 1
+        return VectorClock(v)
+
+    # -- comparison ----------------------------------------------------------
+
+    def _trimmed(self) -> tuple:
+        cutoff = 0
+        for i in range(len(self._v) - 1, -1, -1):
+            if self._v[i] != 0:
+                cutoff = i + 1
+                break
+        return tuple(self._v[:cutoff])
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
+        return self._trimmed() == other._trimmed()
+
+    def __hash__(self) -> int:
+        # Zero-suffix-insensitive, like the reference Hash (vector_clock.rs:53-62).
+        return hash(self._trimmed())
+
+    def fingerprint_key(self) -> tuple:
+        return self._trimmed()
+
+    def partial_cmp(self, rhs: "VectorClock") -> Optional[int]:
+        """-1 / 0 / +1 for happens-before / equal / happens-after; None if
+        concurrent. Reference: vector_clock.rs:84-106."""
+        expected = 0
+        for i in range(max(len(self._v), len(rhs._v))):
+            a = self._v[i] if i < len(self._v) else 0
+            b = rhs._v[i] if i < len(rhs._v) else 0
+            ordering = (a > b) - (a < b)
+            if expected == 0:
+                expected = ordering
+            elif ordering != expected and ordering != 0:
+                return None
+        return expected
+
+    def __lt__(self, rhs: "VectorClock") -> bool:
+        return self.partial_cmp(rhs) == -1
+
+    def __le__(self, rhs: "VectorClock") -> bool:
+        return self.partial_cmp(rhs) in (-1, 0)
+
+    def __gt__(self, rhs: "VectorClock") -> bool:
+        return self.partial_cmp(rhs) == 1
+
+    def __ge__(self, rhs: "VectorClock") -> bool:
+        return self.partial_cmp(rhs) in (0, 1)
+
+    # -- display -------------------------------------------------------------
+
+    def __repr__(self) -> str:
+        return f"VectorClock({self._v!r})"
+
+    def __str__(self) -> str:
+        """Reference display: "<1, 2, ...>" (vector_clock.rs:42-51)."""
+        return "<" + "".join(f"{c}, " for c in self._v) + "...>"

@@ -1,0 +1,48 @@
+"""On-demand engine tests. Reference: src/checker/on_demand.rs:500-540 —
+the engine idles until driven by fingerprint or run_to_completion."""
+
+from stateright_tpu.models.fixtures import BinaryClock, LinearEquation
+
+
+def test_idles_until_driven():
+    checker = LinearEquation(2, 4, 7).checker().spawn_on_demand()
+    # Only the single init state is known; nothing has been expanded.
+    assert checker.unique_state_count() == 1
+    assert checker.state_count() == 1
+    assert not checker.is_done()
+
+
+def test_check_fingerprint_expands_one_node():
+    model = LinearEquation(2, 4, 7)
+    checker = model.checker().spawn_on_demand()
+    init_fp = model.fingerprint_state((0, 0))
+    checker.check_fingerprint(init_fp)
+    # (0,0) expands to (1,0) and (0,1).
+    assert checker.unique_state_count() == 3
+    # Unknown fingerprints are ignored.
+    checker.check_fingerprint(12345)
+    assert checker.unique_state_count() == 3
+    # Expanding a frontier successor works too.
+    checker.check_fingerprint(model.fingerprint_state((1, 0)))
+    assert checker.unique_state_count() == 5  # adds (2,0) and (1,1)
+
+
+def test_run_to_completion_enumerates_full_space():
+    # 2x + 4y = 7 (mod 256) has no solution, so the full 256*256 space is
+    # explored (reference golden: on_demand.rs:522).
+    checker = LinearEquation(2, 4, 7).checker().spawn_on_demand()
+    checker.run_to_completion()
+    checker.join()
+    assert checker.is_done()
+    assert checker.unique_state_count() == 256 * 256
+    checker.assert_no_discovery("solvable")
+
+
+def test_run_to_completion_binary_clock():
+    checker = BinaryClock().checker().spawn_on_demand()
+    checker.run_to_completion()
+    checker.join()
+    # Reference golden: 2 unique states (on_demand.rs:532 asserts 12 for the
+    # 12-state fixture; the analogous exact-count check here).
+    assert checker.unique_state_count() == 2
+    checker.assert_no_discovery("in [0, 1]")

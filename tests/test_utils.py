@@ -1,0 +1,122 @@
+"""Utility tests. Reference: src/util/vector_clock.rs:109-275,
+src/util/densenatmap.rs tests, src/checker/rewrite_plan.rs:126-206."""
+
+import pytest
+
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.symmetry import RewritePlan
+from stateright_tpu.utils import DenseNatMap, VectorClock
+
+
+# -- VectorClock -------------------------------------------------------------
+
+def test_vector_clock_display():
+    assert str(VectorClock([1, 2, 3, 4])) == "<1, 2, 3, 4, ...>"
+    assert str(VectorClock()) == "<...>"
+
+
+def test_vector_clock_eq_ignores_trailing_zeros():
+    assert VectorClock([1, 2]) == VectorClock([1, 2, 0, 0])
+    assert VectorClock() == VectorClock([0, 0])
+    assert VectorClock([1, 2]) != VectorClock([1, 2, 3])
+    assert hash(VectorClock([1, 2])) == hash(VectorClock([1, 2, 0]))
+    assert fingerprint(VectorClock([1, 2])) == fingerprint(VectorClock([1, 2, 0]))
+
+
+def test_vector_clock_merge_max():
+    a, b = VectorClock([1, 5, 0]), VectorClock([3, 2])
+    assert VectorClock.merge_max(a, b) == VectorClock([3, 5, 0])
+
+
+def test_vector_clock_incremented_grows():
+    c = VectorClock().incremented(2)
+    assert c == VectorClock([0, 0, 1])
+    assert c.incremented(0) == VectorClock([1, 0, 1])
+
+
+def test_vector_clock_partial_cmp():
+    assert VectorClock([1, 2]).partial_cmp(VectorClock([1, 2, 0])) == 0
+    assert VectorClock([1, 2]).partial_cmp(VectorClock([1, 3])) == -1
+    assert VectorClock([1, 3]).partial_cmp(VectorClock([1, 2])) == 1
+    # Concurrent clocks are incomparable.
+    assert VectorClock([1, 2, 4]).partial_cmp(VectorClock([1, 3, 0])) is None
+    assert VectorClock([0, 1]) < VectorClock([1, 1])
+    assert not VectorClock([0, 1]) < VectorClock([1, 0])
+
+
+# -- DenseNatMap -------------------------------------------------------------
+
+def test_densenatmap_insert_in_order():
+    m = DenseNatMap()
+    m.insert(0, "first")
+    m.insert(1, "second")
+    assert m[0] == "first" and m[1] == "second"
+    assert len(m) == 2
+    with pytest.raises(ValueError):
+        m.insert(5, "gap")
+
+
+def test_densenatmap_from_pairs_any_order():
+    m = DenseNatMap.from_pairs([(1, "second"), (0, "first")])
+    assert m.values() == ["first", "second"]
+    with pytest.raises(ValueError):
+        DenseNatMap.from_pairs([(0, "a"), (2, "b")])
+    with pytest.raises(ValueError):
+        DenseNatMap.from_pairs([(0, "a"), (0, "b")])
+
+
+def test_densenatmap_eq_and_fingerprint():
+    a = DenseNatMap.from_pairs([(0, 10), (1, 20)])
+    b = DenseNatMap([10, 20])
+    assert a == b
+    assert fingerprint(a) == fingerprint(b)
+
+
+# -- RewritePlan -------------------------------------------------------------
+
+class Pid(int):
+    """A dedicated id type, standing in for actor Id."""
+
+
+def test_rewrite_plan_from_values_to_sort():
+    # The rewrite_plan.rs:87-99 worked example: values [B, C, A] sort to
+    # [A, B, C], so old ids 0,1,2 get new ids 1,2,0.
+    plan = RewritePlan.from_values_to_sort(Pid, ["B", "C", "A"])
+    assert plan.mapping == [1, 2, 0]
+    assert plan.rewrite(Pid(0)) == Pid(1)
+    assert plan.rewrite(Pid(2)) == Pid(0)
+
+
+def test_rewrite_plan_recurses_containers():
+    plan = RewritePlan.from_values_to_sort(Pid, ["B", "C", "A"])
+    assert plan.rewrite([Pid(0), (Pid(1), "x"), {Pid(2)}]) == [
+        Pid(1),
+        (Pid(2), "x"),
+        {Pid(0)},
+    ]
+    assert plan.rewrite({Pid(0): Pid(2)}) == {Pid(1): Pid(0)}
+    # Non-domain scalars pass through untouched — including plain ints.
+    assert plan.rewrite([7, "s"]) == [7, "s"]
+
+
+def test_rewrite_plan_reindex_sorts():
+    plan = RewritePlan.from_values_to_sort(Pid, ["B", "C", "A"])
+    assert plan.reindex(["B", "C", "A"]) == ["A", "B", "C"]
+    # Elements are also rewritten while being permuted.
+    assert plan.reindex([[Pid(0)], [Pid(1)], [Pid(2)]]) == [
+        [Pid(0)],
+        [Pid(1)],
+        [Pid(2)],
+    ]
+
+
+def test_rewrite_plan_rejects_int_domain():
+    with pytest.raises(TypeError):
+        RewritePlan(int, [0, 1])
+
+
+def test_rewrite_plan_stable_sort_for_duplicates():
+    # Equal values keep their relative order (stable), so the plan is
+    # deterministic even with duplicate sort keys.
+    plan = RewritePlan.from_values_to_sort(Pid, ["A", "A", "A"])
+    assert plan.mapping == [0, 1, 2]
