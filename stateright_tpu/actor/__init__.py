@@ -1,0 +1,69 @@
+"""The actor layer: event-driven actors compiled into checkable models.
+
+Reference parity: the `stateright::actor` module (src/actor.rs and
+src/actor/*). Layout:
+
+  - `base`      — `Actor`, `Out`, commands, `ScriptActor`
+  - `ids`       — `Id` (dense index ⇔ socket address), `majority`, `model_peers`
+  - `network`   — `Envelope` + three `Network` delivery semantics
+  - `timers`    — per-actor named-timer sets
+  - `model_state` — `ActorModelState`, `RandomChoices`
+  - `model`     — `ActorModel` + its action types
+"""
+
+from .base import (
+    Actor,
+    CancelTimer,
+    ChooseRandom,
+    Out,
+    ScriptActor,
+    Send,
+    SetTimer,
+    is_no_op,
+    is_no_op_with_timer,
+)
+from .ids import Id, addr_from_id, id_from_addr, majority, model_peers
+from .model import (
+    ActorModel,
+    Crash,
+    Deliver,
+    Drop,
+    SelectRandom,
+    Timeout,
+    model_timeout,
+)
+from .model_state import ActorModelState, RandomChoices
+from .network import Envelope, Network, Ordered, UnorderedDuplicating, UnorderedNonDuplicating
+from .timers import Timers
+
+__all__ = [
+    "Actor",
+    "ActorModel",
+    "ActorModelState",
+    "CancelTimer",
+    "ChooseRandom",
+    "Crash",
+    "Deliver",
+    "Drop",
+    "Envelope",
+    "Id",
+    "Network",
+    "Ordered",
+    "Out",
+    "RandomChoices",
+    "ScriptActor",
+    "SelectRandom",
+    "Send",
+    "SetTimer",
+    "Timeout",
+    "Timers",
+    "UnorderedDuplicating",
+    "UnorderedNonDuplicating",
+    "addr_from_id",
+    "id_from_addr",
+    "is_no_op",
+    "is_no_op_with_timer",
+    "majority",
+    "model_peers",
+    "model_timeout",
+]

@@ -1,0 +1,181 @@
+"""The Actor abstraction: event-driven state machines that emit commands.
+
+Reference parity: the `Actor` trait and `Command`/`Out` types
+(src/actor.rs:158-389). An actor initializes state in `on_start`, then
+reacts to events — `on_msg`, `on_timeout`, `on_random` — by returning a
+revised state and recording commands on the `Out` buffer.
+
+Python adaptation of the reference's copy-on-write (`Cow<State>`) protocol:
+event handlers receive the current state (treat it as immutable) and return
+either a **new state value** (the `Cow::Owned` case) or **None** meaning
+"state unchanged" (the `Cow::Borrowed` case). Returning None with an empty
+`Out` is a no-op, which the model checker prunes (actor.rs:269-274).
+
+The reference's `Choice<A, B>` machinery for heterogeneous actor systems
+(actor.rs:391-548) is unnecessary here: Python lists hold actors of
+different classes natively, and distinct state dataclass types fingerprint
+distinctly by construction. Just mix actor instances in `ActorModel.actors`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, List, Optional, Tuple
+
+from .ids import Id
+
+
+# ---------------------------------------------------------------------------
+# Commands (actor.rs:160-166)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Send:
+    """Send `msg` to `dst`."""
+
+    dst: Id
+    msg: Any
+
+
+@dataclass(frozen=True)
+class SetTimer:
+    """Set/reset a named timer. The duration range is only meaningful to the
+    real-network runtime; the checker abstracts it away (model.rs:73-78)."""
+
+    timer: Any
+    duration: Tuple[float, float] = (0.0, 0.0)
+
+
+@dataclass(frozen=True)
+class CancelTimer:
+    timer: Any
+
+
+@dataclass(frozen=True)
+class ChooseRandom:
+    """Record a nondeterministic choice: a branch per element of `choices`.
+    An empty `choices` removes any pending choice under `key`."""
+
+    key: str
+    choices: Tuple[Any, ...]
+
+
+class Out:
+    """Buffer of commands recorded by an actor during one event.
+
+    Reference: `Out` (actor.rs:174-258).
+    """
+
+    __slots__ = ("commands",)
+
+    def __init__(self):
+        self.commands: List[Any] = []
+
+    def send(self, recipient: Id, msg: Any) -> None:
+        self.commands.append(Send(Id(recipient), msg))
+
+    def broadcast(self, recipients: Iterable[Id], msg: Any) -> None:
+        for recipient in recipients:
+            self.send(recipient, msg)
+
+    def set_timer(self, timer: Any, duration: Tuple[float, float] = (0.0, 0.0)) -> None:
+        self.commands.append(SetTimer(timer, duration))
+
+    def cancel_timer(self, timer: Any) -> None:
+        self.commands.append(CancelTimer(timer))
+
+    def choose_random(self, key: str, choices: Iterable[Any]) -> None:
+        self.commands.append(ChooseRandom(key, tuple(choices)))
+
+    def remove_random(self, key: str) -> None:
+        self.commands.append(ChooseRandom(key, ()))
+
+    def append(self, other: "Out") -> None:
+        self.commands.extend(other.commands)
+        other.commands.clear()
+
+    def __iter__(self):
+        return iter(self.commands)
+
+    def __len__(self) -> int:
+        return len(self.commands)
+
+    def __repr__(self) -> str:
+        return f"Out({self.commands!r})"
+
+
+def is_no_op(returned_state: Optional[Any], out: Out) -> bool:
+    """True when the handler neither revised state nor emitted commands.
+
+    Reference: actor.rs:269-274 (Cow::Borrowed + empty out).
+    """
+    return returned_state is None and not out.commands
+
+
+def is_no_op_with_timer(returned_state: Optional[Any], out: Out, timer: Any) -> bool:
+    """True when the handler only re-set the very timer that fired.
+
+    Reference: actor.rs:276-287.
+    """
+    if returned_state is not None or len(out.commands) != 1:
+        return False
+    cmd = out.commands[0]
+    return isinstance(cmd, SetTimer) and cmd.timer == timer
+
+
+# ---------------------------------------------------------------------------
+# The Actor interface (actor.rs:293-389)
+# ---------------------------------------------------------------------------
+
+class Actor:
+    """An event-driven state machine.
+
+    Handlers return the revised state, or None for "unchanged". States must
+    be treated as immutable values (frozen dataclasses, tuples, ints, ...):
+    never mutate the `state` argument in place.
+    """
+
+    def on_start(self, id: Id, out: Out) -> Any:
+        """Return the initial state, optionally emitting commands."""
+        raise NotImplementedError
+
+    def on_msg(self, id: Id, state: Any, src: Id, msg: Any, out: Out) -> Optional[Any]:
+        """React to a delivered message. None means state unchanged."""
+        return None
+
+    def on_timeout(self, id: Id, state: Any, timer: Any, out: Out) -> Optional[Any]:
+        """React to a fired timer. None means state unchanged."""
+        return None
+
+    def on_random(self, id: Id, state: Any, random: Any, out: Out) -> Optional[Any]:
+        """React to a resolved random choice. None means state unchanged."""
+        return None
+
+    def name(self) -> str:
+        return ""
+
+
+class ScriptActor(Actor):
+    """Sends a fixed message sequence, one message per delivery received.
+
+    The Python port of the reference's `Vec<(Id, Msg)>` actor impl
+    (actor.rs:565-602); useful for modeling external test inputs.
+    State is the index of the next script entry.
+    """
+
+    def __init__(self, script: List[Tuple[Id, Any]]):
+        self.script = list(script)
+
+    def on_start(self, id: Id, out: Out) -> int:
+        if self.script:
+            dst, msg = self.script[0]
+            out.send(dst, msg)
+            return 1
+        return 0
+
+    def on_msg(self, id: Id, state: int, src: Id, msg: Any, out: Out) -> Optional[int]:
+        if state < len(self.script):
+            dst, next_msg = self.script[state]
+            out.send(dst, next_msg)
+            return state + 1
+        return None

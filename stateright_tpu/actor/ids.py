@@ -1,0 +1,70 @@
+"""Actor identity: a dense index for checking, a socket address for spawning.
+
+Reference parity: `Id` (src/actor.rs:109-157) and the Id ⇔ SocketAddrV4
+bijection used by the real-network runtime (src/actor/spawn.rs:10-34):
+the 64-bit id packs a 32-bit IPv4 address in the upper lanes and a 16-bit
+port in the lower, so model ids 0, 1, 2, ... double as 0.0.0.0:{0,1,2}.
+
+`Id` subclasses `int` so it indexes lists directly and fingerprints as a
+plain integer, while remaining a distinct type for `RewritePlan` symmetry
+rewriting (which must not remap arbitrary ints).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+
+class Id(int):
+    """Uniquely identifies an actor."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return f"Id({int(self)})"
+
+    def __str__(self) -> str:
+        ip, port = addr_from_id(self)
+        return f"{ip}:{port}"
+
+    @staticmethod
+    def vec_from(ids: Iterable[int]) -> List["Id"]:
+        """Reference: actor.rs:131-145."""
+        return [Id(i) for i in ids]
+
+    @staticmethod
+    def from_addr(ip: str, port: int) -> "Id":
+        return id_from_addr(ip, port)
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return addr_from_id(self)
+
+
+def id_from_addr(ip: str, port: int) -> Id:
+    """Pack an (IPv4, port) socket address into an Id. Reference: spawn.rs:22-34."""
+    octets = [int(o) for o in ip.split(".")]
+    if len(octets) != 4 or any(not 0 <= o <= 255 for o in octets):
+        raise ValueError(f"not an IPv4 address: {ip!r}")
+    if not 0 <= port <= 0xFFFF:
+        raise ValueError(f"not a port: {port!r}")
+    ip_u32 = (octets[0] << 24) | (octets[1] << 16) | (octets[2] << 8) | octets[3]
+    return Id((ip_u32 << 16) | port)
+
+
+def addr_from_id(id: int) -> Tuple[str, int]:
+    """Unpack an Id into its (IPv4, port) socket address. Reference: spawn.rs:10-20."""
+    ip_u32 = (int(id) >> 16) & 0xFFFFFFFF
+    port = int(id) & 0xFFFF
+    ip = f"{(ip_u32 >> 24) & 0xFF}.{(ip_u32 >> 16) & 0xFF}.{(ip_u32 >> 8) & 0xFF}.{ip_u32 & 0xFF}"
+    return ip, port
+
+
+def majority(cluster_size: int) -> int:
+    """Number of nodes constituting a majority. Reference: actor.rs:604-607."""
+    return cluster_size // 2 + 1
+
+
+def model_peers(self_ix: int, count: int) -> List[Id]:
+    """All ids in a `count`-actor cluster except `self_ix`. Reference: model.rs:81-87."""
+    return [Id(j) for j in range(count) if j != self_ix]

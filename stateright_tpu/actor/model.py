@@ -1,0 +1,440 @@
+"""ActorModel: compiles an actor system into a checkable `Model`.
+
+Reference parity: src/actor/model.rs. The model's action space per state is:
+
+  1. `Deliver` — one per deliverable envelope (head-of-flow only for
+     `Ordered` networks, model.rs:269-275);
+  2. `Drop` — one per deliverable envelope, iff the network is lossy;
+  3. `Timeout` — one per pending (actor, timer);
+  4. `Crash` — one per live actor, while fewer than `max_crashes` crashed;
+  5. `SelectRandom` — one per (actor, key, choice) pending random branch.
+
+Transitions preserve the reference's pruning semantics exactly:
+a `Deliver` whose handler is a no-op is pruned unless the network is
+`Ordered` (model.rs:345-347); a `Timeout` that only renews its own timer is
+pruned (model.rs:377-381); a crashed actor receives nothing (model.rs:335).
+
+Per-actor states are shared structurally between system states (the
+reference's `Arc<State>` copy-on-write, model.rs:340, 371-373): a transition
+copies the state-pointer list and replaces only the changed entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..core import Expectation, Model, Property
+from .base import (
+    Actor,
+    CancelTimer,
+    ChooseRandom,
+    Out,
+    Send,
+    SetTimer,
+    is_no_op,
+    is_no_op_with_timer,
+)
+from .ids import Id
+from .model_state import ActorModelState, RandomChoices
+from .network import Envelope, Network, Ordered
+from .timers import Timers
+
+
+# ---------------------------------------------------------------------------
+# Actions (model.rs:42-63)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Deliver:
+    src: Id
+    dst: Id
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Drop:
+    envelope: Envelope
+
+
+@dataclass(frozen=True)
+class Timeout:
+    id: Id
+    timer: Any
+
+
+@dataclass(frozen=True)
+class Crash:
+    id: Id
+
+
+@dataclass(frozen=True)
+class SelectRandom:
+    actor: Id
+    key: str
+    random: Any
+
+
+def model_timeout() -> Tuple[float, float]:
+    """Arbitrary timeout range for checking (value irrelevant; model.rs:73-78)."""
+    return (0.0, 0.0)
+
+
+class ActorModel(Model):
+    """A system of actors communicating over a modeled network.
+
+    `cfg` is arbitrary read-only configuration available to properties and
+    history hooks; `init_history` seeds the auxiliary history variable `H`
+    (see "Auxiliary Variables in TLA"; model.rs:18-40).
+    """
+
+    def __init__(self, cfg: Any = None, init_history: Any = ()):
+        self.actors: List[Actor] = []
+        self.cfg = cfg
+        self.init_history = init_history
+        self.init_network: Network = Network.new_unordered_duplicating()
+        self.lossy_network: bool = False
+        self.max_crashes: int = 0
+        self._properties: List[Property] = []
+        self.record_msg_in: Callable[[Any, Any, Envelope], Optional[Any]] = (
+            lambda cfg, history, env: None
+        )
+        self.record_msg_out: Callable[[Any, Any, Envelope], Optional[Any]] = (
+            lambda cfg, history, env: None
+        )
+        self._within_boundary: Callable[[Any, ActorModelState], bool] = (
+            lambda cfg, state: True
+        )
+
+    # -- builder (model.rs:89-226) ------------------------------------------
+
+    def actor(self, actor: Actor) -> "ActorModel":
+        self.actors.append(actor)
+        return self
+
+    def add_actors(self, actors) -> "ActorModel":
+        for actor in actors:
+            self.actors.append(actor)
+        return self
+
+    def with_init_network(self, network: Network) -> "ActorModel":
+        self.init_network = network
+        return self
+
+    def with_lossy_network(self, lossy: bool) -> "ActorModel":
+        self.lossy_network = lossy
+        return self
+
+    def with_max_crashes(self, max_crashes: int) -> "ActorModel":
+        self.max_crashes = max_crashes
+        return self
+
+    def property(
+        self, expectation, name: Optional[str] = None, condition=None
+    ):
+        """With three arguments: add a property (builder, model.rs:143-157).
+        With one string argument: look it up (the `Model.property` accessor)."""
+        if name is None and condition is None:
+            return Model.property(self, expectation)
+        self._properties.append(Property(expectation, name, condition))
+        return self
+
+    def with_record_msg_in(self, hook) -> "ActorModel":
+        self.record_msg_in = hook
+        return self
+
+    def with_record_msg_out(self, hook) -> "ActorModel":
+        self.record_msg_out = hook
+        return self
+
+    def with_within_boundary(self, hook) -> "ActorModel":
+        self._within_boundary = hook
+        return self
+
+    # -- command processing (model.rs:188-226) ------------------------------
+
+    def _process_commands(self, id: Id, out: Out, state: ActorModelState) -> None:
+        index = int(id)
+        for cmd in out.commands:
+            if isinstance(cmd, Send):
+                env = Envelope(id, cmd.dst, cmd.msg)
+                history = self.record_msg_out(self.cfg, state.history, env)
+                if history is not None:
+                    state.history = history
+                state.network.send(env)
+            elif isinstance(cmd, SetTimer):
+                while len(state.timers_set) <= index:
+                    state.timers_set.append(Timers())
+                state.timers_set[index].set(cmd.timer)
+            elif isinstance(cmd, CancelTimer):
+                state.timers_set[index].cancel(cmd.timer)
+            elif isinstance(cmd, ChooseRandom):
+                if not cmd.choices:
+                    state.random_choices[index].remove(cmd.key)
+                else:
+                    state.random_choices[index].insert(cmd.key, cmd.choices)
+            else:
+                raise TypeError(f"unknown command: {cmd!r}")
+
+    # -- Model interface (model.rs:228-426) ----------------------------------
+
+    def init_states(self) -> List[ActorModelState]:
+        state = ActorModelState(
+            actor_states=[],
+            network=self.init_network.copy(),
+            timers_set=[Timers() for _ in self.actors],
+            random_choices=[RandomChoices() for _ in self.actors],
+            crashed=[False] * len(self.actors),
+            history=self.init_history,
+        )
+        for index, actor in enumerate(self.actors):
+            id = Id(index)
+            out = Out()
+            actor_state = actor.on_start(id, out)
+            state.actor_states.append(actor_state)
+            self._process_commands(id, out, state)
+        return [state]
+
+    def actions(self, state: ActorModelState, actions: List[Any]) -> None:
+        is_ordered = isinstance(self.init_network, Ordered)
+        prev_channel = None
+        for env in state.network.iter_deliverable():
+            if self.lossy_network:
+                actions.append(Drop(env))
+            if int(env.dst) < len(self.actors):  # ignored if recipient DNE
+                if is_ordered:
+                    channel = (env.src, env.dst)
+                    if prev_channel == channel:
+                        continue  # queued behind the previous message
+                    prev_channel = channel
+                actions.append(Deliver(env.src, env.dst, env.msg))
+
+        for index, timers in enumerate(state.timers_set):
+            for timer in timers:
+                actions.append(Timeout(Id(index), timer))
+
+        if sum(state.crashed) < self.max_crashes:
+            for index, crashed in enumerate(state.crashed):
+                if not crashed:
+                    actions.append(Crash(Id(index)))
+
+        for index, randoms in enumerate(state.random_choices):
+            for key in sorted(randoms.map):
+                for choice in randoms.map[key]:
+                    actions.append(SelectRandom(Id(index), key, choice))
+
+    def next_state(
+        self, last_state: ActorModelState, action: Any
+    ) -> Optional[ActorModelState]:
+        if isinstance(action, Drop):
+            next_state = last_state.clone()
+            next_state.network.on_drop(action.envelope)
+            return next_state
+
+        if isinstance(action, Deliver):
+            index = int(action.dst)
+            if index >= len(last_state.actor_states):
+                return None  # not all messages can be delivered
+            if last_state.crashed[index]:
+                return None
+            last_actor_state = last_state.actor_states[index]
+            out = Out()
+            returned = self.actors[index].on_msg(
+                action.dst, last_actor_state, action.src, action.msg, out
+            )
+            if is_no_op(returned, out) and not isinstance(self.init_network, Ordered):
+                return None
+            env = Envelope(action.src, action.dst, action.msg)
+            history = self.record_msg_in(self.cfg, last_state.history, env)
+            next_state = last_state.clone()
+            next_state.network.on_deliver(env)
+            if returned is not None:
+                next_state.actor_states[index] = returned
+            if history is not None:
+                next_state.history = history
+            self._process_commands(action.dst, out, next_state)
+            return next_state
+
+        if isinstance(action, Timeout):
+            index = int(action.id)
+            out = Out()
+            returned = self.actors[index].on_timeout(
+                action.id, last_state.actor_states[index], action.timer, out
+            )
+            if is_no_op_with_timer(returned, out, action.timer):
+                return None
+            next_state = last_state.clone()
+            next_state.timers_set[index].cancel(action.timer)  # timer consumed
+            if returned is not None:
+                next_state.actor_states[index] = returned
+            self._process_commands(action.id, out, next_state)
+            return next_state
+
+        if isinstance(action, Crash):
+            index = int(action.id)
+            next_state = last_state.clone()
+            next_state.timers_set[index].cancel_all()
+            next_state.random_choices[index] = RandomChoices()
+            next_state.crashed[index] = True
+            return next_state
+
+        if isinstance(action, SelectRandom):
+            index = int(action.actor)
+            out = Out()
+            returned = self.actors[index].on_random(
+                action.actor, last_state.actor_states[index], action.random, out
+            )
+            next_state = last_state.clone()
+            next_state.random_choices[index].remove(action.key)  # choice consumed
+            if returned is not None:
+                next_state.actor_states[index] = returned
+            self._process_commands(action.actor, out, next_state)
+            return next_state
+
+        raise TypeError(f"unknown action: {action!r}")
+
+    def properties(self) -> List[Property]:
+        return list(self._properties)
+
+    def within_boundary(self, state: ActorModelState) -> bool:
+        return self._within_boundary(self.cfg, state)
+
+    # -- display (model.rs:428-548) ------------------------------------------
+
+    def format_action(self, action: Any) -> str:
+        if isinstance(action, Deliver):
+            return f"{action.src!r} → {action.msg!r} → {action.dst!r}"
+        if isinstance(action, SelectRandom):
+            return f"{action.actor!r} select random {action.random!r}"
+        return repr(action)
+
+    def format_step(self, last_state: ActorModelState, action: Any) -> Optional[str]:
+        def actor_step(last, returned, out) -> str:
+            lines = [f"OUT: {out.commands!r}", ""]
+            if returned is not None:
+                lines += [f"NEXT_STATE: {returned!r}", "", f"PREV_STATE: {last!r}"]
+            else:
+                lines.append(f"UNCHANGED: {last!r}")
+            return "\n".join(lines) + "\n"
+
+        if isinstance(action, Drop):
+            return f"DROP: {action.envelope!r}"
+        if isinstance(action, Deliver):
+            index = int(action.dst)
+            if index >= len(last_state.actor_states):
+                return None
+            out = Out()
+            returned = self.actors[index].on_msg(
+                action.dst, last_state.actor_states[index], action.src, action.msg, out
+            )
+            return actor_step(last_state.actor_states[index], returned, out)
+        if isinstance(action, Timeout):
+            index = int(action.id)
+            if index >= len(last_state.actor_states):
+                return None
+            out = Out()
+            returned = self.actors[index].on_timeout(
+                action.id, last_state.actor_states[index], action.timer, out
+            )
+            return actor_step(last_state.actor_states[index], returned, out)
+        if isinstance(action, Crash):
+            index = int(action.id)
+            if index >= len(last_state.actor_states):
+                return None
+            return actor_step(last_state.actor_states[index], None, Out())
+        if isinstance(action, SelectRandom):
+            index = int(action.actor)
+            if index >= len(last_state.actor_states):
+                return None
+            out = Out()
+            returned = self.actors[index].on_random(
+                action.actor, last_state.actor_states[index], action.random, out
+            )
+            return actor_step(last_state.actor_states[index], returned, out)
+        return None
+
+    def as_svg(self, path) -> Optional[str]:
+        """Sequence diagram of a path: lifelines + message/timeout arrows.
+
+        Role parity with model.rs:550-754 (layout is our own).
+        """
+        letter_px = 10
+        actor_names = []
+        for i, actor in enumerate(self.actors):
+            name = actor.name()
+            actor_names.append(f"{name} {i}" if name else str(i))
+        n = len(actor_names)
+        if n == 0:
+            return None
+        spacing = max(120, 20 + letter_px * max(len(s) for s in actor_names))
+        steps = path.into_actions()
+        height = 60 + 40 * (len(steps) + 1)
+        width = spacing * n + 40
+
+        def x(actor_index: int) -> int:
+            return 20 + spacing * actor_index + spacing // 2
+
+        svg = [
+            f'<svg version="1.1" baseProfile="full" width="{width}" height="{height}" '
+            'xmlns="http://www.w3.org/2000/svg">',
+            "<style>"
+            "text { font-family: monospace; font-size: 12px; }"
+            ".lifeline { stroke: #888; stroke-dasharray: 4; }"
+            ".msg { stroke: #111; stroke-width: 1.5; marker-end: url(#arrow); }"
+            ".evt { fill: #0366d6; }"
+            "</style>",
+            '<defs><marker id="arrow" markerWidth="10" markerHeight="10" refX="9" '
+            'refY="3" orient="auto"><path d="M0,0 L9,3 L0,6 z" fill="#111"/></marker></defs>',
+        ]
+        for i, label in enumerate(actor_names):
+            svg.append(
+                f'<text x="{x(i)}" y="20" text-anchor="middle">{_svg_escape(label)}</text>'
+            )
+            svg.append(
+                f'<line class="lifeline" x1="{x(i)}" y1="30" x2="{x(i)}" y2="{height - 10}"/>'
+            )
+        y = 60
+        for action in steps:
+            if isinstance(action, Deliver):
+                x1, x2 = x(int(action.src)), x(int(action.dst))
+                if x1 == x2:
+                    x2 += 10
+                svg.append(f'<line class="msg" x1="{x1}" y1="{y}" x2="{x2}" y2="{y}"/>')
+                mid = (x1 + x2) // 2
+                svg.append(
+                    f'<text x="{mid}" y="{y - 5}" text-anchor="middle">'
+                    f"{_svg_escape(repr(action.msg))}</text>"
+                )
+            elif isinstance(action, Timeout):
+                cx = x(int(action.id))
+                svg.append(f'<circle class="evt" cx="{cx}" cy="{y}" r="5"/>')
+                svg.append(
+                    f'<text x="{cx + 10}" y="{y + 4}">'
+                    f"timeout {_svg_escape(repr(action.timer))}</text>"
+                )
+            elif isinstance(action, Crash):
+                cx = x(int(action.id))
+                svg.append(
+                    f'<text x="{cx}" y="{y + 4}" text-anchor="middle" fill="#c00">✖ crash</text>'
+                )
+            elif isinstance(action, Drop):
+                env = action.envelope
+                cx = x(int(env.src))
+                svg.append(
+                    f'<text x="{cx + 10}" y="{y + 4}" fill="#c00">'
+                    f"drop {_svg_escape(repr(env.msg))}</text>"
+                )
+            elif isinstance(action, SelectRandom):
+                cx = x(int(action.actor))
+                svg.append(f'<circle class="evt" cx="{cx}" cy="{y}" r="5"/>')
+                svg.append(
+                    f'<text x="{cx + 10}" y="{y + 4}">'
+                    f"random {_svg_escape(repr(action.random))}</text>"
+                )
+            y += 40
+        svg.append("</svg>")
+        return "".join(svg)
+
+
+def _svg_escape(s: str) -> str:
+    return s.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")

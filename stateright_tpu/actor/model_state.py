@@ -1,0 +1,145 @@
+"""ActorModelState: a snapshot in time of the entire actor system.
+
+Reference: src/actor/model_state.rs. Holds per-actor states (structurally
+shared across system states — the Python analogue of the reference's
+`Arc<State>` COW discipline), the network, pending timers, pending random
+choices, crash flags, and the auxiliary history.
+
+Hash/equality parity (model_state.rs:121-182): `crashed` and
+`random_choices` are **excluded** from both the fingerprint and equality —
+two states differing only in crash flags or pending random choices collapse
+into one visited-set entry, exactly as in the reference.
+
+The symmetry `representative()` sorts actor states into a canonical order
+and rewrites every embedded `Id` accordingly (model_state.rs:163-182).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..fingerprint import canonical_bytes, fingerprint
+from ..symmetry import RewritePlan
+from .ids import Id
+from .network import Network
+from .timers import Timers
+
+
+class RandomChoices:
+    """Pending `choose_random` branches for one actor: key -> choice list.
+
+    Reference: model_state.rs:24-62.
+    """
+
+    __slots__ = ("map",)
+
+    def __init__(self, map: Optional[Dict[str, Tuple[Any, ...]]] = None):
+        self.map: Dict[str, Tuple[Any, ...]] = dict(map) if map else {}
+
+    def copy(self) -> "RandomChoices":
+        return RandomChoices(self.map)
+
+    def insert(self, key: str, choices) -> None:
+        self.map[key] = tuple(choices)
+
+    def remove(self, key: str) -> None:
+        self.map.pop(key, None)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, RandomChoices) and self.map == other.map
+
+    def __repr__(self) -> str:
+        return f"RandomChoices({self.map!r})"
+
+    def fingerprint_key(self):
+        return self.map
+
+    def rewrite_with(self, plan) -> "RandomChoices":
+        return RandomChoices(
+            {k: tuple(plan.rewrite(c) for c in v) for k, v in self.map.items()}
+        )
+
+
+class ActorModelState:
+    """System snapshot: actor states + network + timers + randoms + crashes + history."""
+
+    __slots__ = (
+        "actor_states",
+        "network",
+        "timers_set",
+        "random_choices",
+        "crashed",
+        "history",
+    )
+
+    def __init__(
+        self,
+        actor_states: List[Any],
+        network: Network,
+        timers_set: List[Timers],
+        random_choices: List[RandomChoices],
+        crashed: List[bool],
+        history: Any,
+    ):
+        self.actor_states = list(actor_states)
+        self.network = network
+        self.timers_set = list(timers_set)
+        self.random_choices = list(random_choices)
+        self.crashed = list(crashed)
+        self.history = history
+
+    def clone(self) -> "ActorModelState":
+        """A next-state scratch copy: containers are copied, the per-actor
+        states themselves are shared (the `Arc<State>` analogue)."""
+        return ActorModelState(
+            actor_states=list(self.actor_states),
+            network=self.network.copy(),
+            timers_set=[t.copy() for t in self.timers_set],
+            random_choices=[r.copy() for r in self.random_choices],
+            crashed=list(self.crashed),
+            history=self.history,
+        )
+
+    # -- identity (crashed + random_choices excluded; model_state.rs:121-162) --
+
+    def fingerprint_key(self):
+        return (
+            tuple(self.actor_states),
+            self.history,
+            tuple(self.timers_set),
+            self.network,
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ActorModelState)
+            and self.actor_states == other.actor_states
+            and self.history == other.history
+            and self.timers_set == other.timers_set
+            and self.network == other.network
+        )
+
+    def __hash__(self) -> int:
+        return fingerprint(self)
+
+    def __repr__(self) -> str:
+        return (
+            f"ActorModelState(actor_states={self.actor_states!r}, "
+            f"history={self.history!r}, timers_set={self.timers_set!r}, "
+            f"network={self.network!r}, crashed={self.crashed!r})"
+        )
+
+    # -- symmetry (model_state.rs:163-182) -----------------------------------
+
+    def representative(self) -> "ActorModelState":
+        plan = RewritePlan.from_values_to_sort(
+            Id, [canonical_bytes(s) for s in self.actor_states]
+        )
+        return ActorModelState(
+            actor_states=plan.reindex(self.actor_states),
+            network=self.network.rewrite_with(plan),
+            timers_set=plan.reindex(self.timers_set),
+            random_choices=plan.reindex(self.random_choices),
+            crashed=plan.reindex(self.crashed),
+            history=plan.rewrite(self.history),
+        )

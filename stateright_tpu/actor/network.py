@@ -1,0 +1,339 @@
+"""In-memory network models: the "communication backend" that gets checked.
+
+Reference parity: `Network`/`Envelope` and the deliverable iterators
+(src/actor/network.rs:24-68, 203-316, 350-440). Three delivery semantics:
+
+  - `UnorderedDuplicating`  — messages race and can be redelivered; the set of
+    in-flight envelopes only grows (drops remove). Remembers the last
+    delivered envelope so that a delivery that does not change actor state
+    still produces a distinct fingerprint (network.rs:226-229).
+  - `UnorderedNonDuplicating` — a multiset; delivery consumes one copy.
+  - `Ordered` — per directed (src, dst) flow FIFO; only the head of each flow
+    is deliverable (enforced here *and* in `ActorModel.actions`,
+    model.rs:269-275).
+
+Determinism note (a deliberate improvement over the reference): envelope
+iteration is sorted by canonical encoding, so action enumeration order — and
+therefore visit order and discovery traces — is fully deterministic across
+runs and platforms, where the reference relies on fixed-seed HashMap order.
+
+Messages may be any canonically-fingerprintable Python value (ints, strings,
+tuples, frozen dataclasses, ...). Network values are cloned before mutation;
+a `Network` held in an `ActorModelState` is never mutated in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, List, NamedTuple, Optional, Tuple
+
+from ..fingerprint import canonical_bytes
+from .ids import Id
+
+
+class _EnvelopeBase(NamedTuple):
+    src: Id
+    dst: Id
+    msg: Any
+
+
+class Envelope(_EnvelopeBase):
+    """The source, destination, and payload of an in-flight message.
+
+    Reference: network.rs:24-29. `src`/`dst` are coerced to `Id` on
+    construction so symmetry rewriting (which remaps `Id`s, never plain
+    ints) sees every envelope, regardless of how the user built it.
+    """
+
+    __slots__ = ()
+
+    def __new__(cls, src, dst, msg):
+        return super().__new__(cls, Id(src), Id(dst), msg)
+
+
+def _env_sort_key(env: Envelope) -> Tuple[int, int, bytes]:
+    return (int(env.src), int(env.dst), canonical_bytes(env.msg))
+
+
+class Network:
+    """Base class for the three delivery semantics. Reference: network.rs:46-68."""
+
+    # -- constructors --------------------------------------------------------
+
+    @staticmethod
+    def new_unordered_duplicating(envelopes: Iterable[Envelope] = ()) -> "Network":
+        net = UnorderedDuplicating()
+        for env in envelopes:
+            net.send(env)
+        return net
+
+    @staticmethod
+    def new_unordered_duplicating_with_last_msg(
+        envelopes: Iterable[Envelope], last_msg: Optional[Envelope]
+    ) -> "Network":
+        net = UnorderedDuplicating()
+        for env in envelopes:
+            net.send(env)
+        net.last_msg = last_msg
+        return net
+
+    @staticmethod
+    def new_unordered_nonduplicating(envelopes: Iterable[Envelope] = ()) -> "Network":
+        net = UnorderedNonDuplicating()
+        for env in envelopes:
+            net.send(env)
+        return net
+
+    @staticmethod
+    def new_ordered(envelopes: Iterable[Envelope] = ()) -> "Network":
+        net = Ordered()
+        for env in envelopes:
+            net.send(env)
+        return net
+
+    @staticmethod
+    def names() -> List[str]:
+        """Reference: network.rs:140-151."""
+        return ["ordered", "unordered_duplicating", "unordered_nonduplicating"]
+
+    @staticmethod
+    def from_name(name: str) -> "Network":
+        """Parse a network name from a CLI. Reference: network.rs:318-331."""
+        if name == "ordered":
+            return Network.new_ordered()
+        if name == "unordered_duplicating":
+            return Network.new_unordered_duplicating()
+        if name == "unordered_nonduplicating":
+            return Network.new_unordered_nonduplicating()
+        raise ValueError(f"unable to parse network name: {name}")
+
+    # -- value-object interface ---------------------------------------------
+
+    def copy(self) -> "Network":
+        raise NotImplementedError
+
+    def send(self, envelope: Envelope) -> None:
+        raise NotImplementedError
+
+    def on_deliver(self, envelope: Envelope) -> None:
+        raise NotImplementedError
+
+    def on_drop(self, envelope: Envelope) -> None:
+        raise NotImplementedError
+
+    def iter_deliverable(self) -> Iterator[Envelope]:
+        """Envelopes a `Deliver` action may target, in deterministic order."""
+        raise NotImplementedError
+
+    def iter_all(self) -> Iterator[Envelope]:
+        """Every in-flight envelope, including multiset/queue duplicates."""
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.iter_all())
+
+    def fingerprint_key(self):
+        raise NotImplementedError
+
+    def __hash__(self) -> int:
+        return hash(canonical_bytes(self.fingerprint_key()))
+
+    def rewrite_with(self, plan) -> "Network":
+        raise NotImplementedError
+
+
+class UnorderedDuplicating(Network):
+    """Unordered + redeliverable: a grow-only set of envelopes (drops remove),
+    plus the last delivered envelope. Reference: network.rs:52, 226-229.
+    """
+
+    __slots__ = ("envelopes", "last_msg", "_sorted")
+
+    def __init__(self):
+        self.envelopes: set = set()
+        self.last_msg: Optional[Envelope] = None
+        self._sorted: Optional[List[Envelope]] = None  # lazy, shared via copy()
+
+    def copy(self) -> "UnorderedDuplicating":
+        new = UnorderedDuplicating.__new__(UnorderedDuplicating)
+        new.envelopes = set(self.envelopes)
+        new.last_msg = self.last_msg
+        new._sorted = self._sorted
+        return new
+
+    def send(self, envelope: Envelope) -> None:
+        if envelope not in self.envelopes:
+            self.envelopes.add(envelope)
+            self._sorted = None
+
+    def on_deliver(self, envelope: Envelope) -> None:
+        # Delivery does not consume: the message may race/redeliver. Remember
+        # it so no-op deliveries still perturb the fingerprint.
+        self.last_msg = envelope
+
+    def on_drop(self, envelope: Envelope) -> None:
+        if envelope in self.envelopes:
+            self.envelopes.discard(envelope)
+            self._sorted = None
+
+    def iter_deliverable(self) -> Iterator[Envelope]:
+        if self._sorted is None:
+            self._sorted = sorted(self.envelopes, key=_env_sort_key)
+        return iter(self._sorted)
+
+    def iter_all(self) -> Iterator[Envelope]:
+        return self.iter_deliverable()
+
+    def fingerprint_key(self):
+        return (frozenset(self.envelopes), self.last_msg)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, UnorderedDuplicating)
+            and self.envelopes == other.envelopes
+            and self.last_msg == other.last_msg
+        )
+
+    __hash__ = Network.__hash__
+
+    def __repr__(self) -> str:
+        return (
+            f"UnorderedDuplicating({sorted(self.envelopes, key=_env_sort_key)!r}, "
+            f"last_msg={self.last_msg!r})"
+        )
+
+    def rewrite_with(self, plan) -> "UnorderedDuplicating":
+        new = UnorderedDuplicating()
+        new.envelopes = {plan.rewrite(env) for env in self.envelopes}
+        new.last_msg = None if self.last_msg is None else plan.rewrite(self.last_msg)
+        return new
+
+
+class UnorderedNonDuplicating(Network):
+    """Unordered, delivered at most once: a multiset. Reference: network.rs:55."""
+
+    __slots__ = ("counts", "_sorted")
+
+    def __init__(self):
+        self.counts: dict = {}
+        self._sorted: Optional[List[Envelope]] = None  # lazy, shared via copy()
+
+    def copy(self) -> "UnorderedNonDuplicating":
+        new = UnorderedNonDuplicating.__new__(UnorderedNonDuplicating)
+        new.counts = dict(self.counts)
+        new._sorted = self._sorted
+        return new
+
+    def send(self, envelope: Envelope) -> None:
+        if envelope in self.counts:
+            self.counts[envelope] += 1
+        else:
+            self.counts[envelope] = 1
+            self._sorted = None
+
+    def _remove_one(self, envelope: Envelope) -> None:
+        count = self.counts.get(envelope)
+        if count is None:
+            raise KeyError(f"envelope not found: {envelope!r}")
+        if count == 1:
+            del self.counts[envelope]
+            self._sorted = None
+        else:
+            self.counts[envelope] = count - 1
+
+    def on_deliver(self, envelope: Envelope) -> None:
+        self._remove_one(envelope)
+
+    def on_drop(self, envelope: Envelope) -> None:
+        self._remove_one(envelope)
+
+    def iter_deliverable(self) -> Iterator[Envelope]:
+        if self._sorted is None:
+            self._sorted = sorted(self.counts, key=_env_sort_key)
+        return iter(self._sorted)
+
+    def iter_all(self) -> Iterator[Envelope]:
+        for env in self.iter_deliverable():
+            for _ in range(self.counts[env]):
+                yield env
+
+    def fingerprint_key(self):
+        return dict(self.counts)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, UnorderedNonDuplicating) and self.counts == other.counts
+
+    __hash__ = Network.__hash__
+
+    def __repr__(self) -> str:
+        return f"UnorderedNonDuplicating({self.counts!r})"
+
+    def rewrite_with(self, plan) -> "UnorderedNonDuplicating":
+        new = UnorderedNonDuplicating()
+        for env, count in self.counts.items():
+            new.counts[plan.rewrite(env)] = count
+        return new
+
+
+class Ordered(Network):
+    """Per-(src, dst)-flow FIFO ordering; no cross-flow ordering.
+
+    Reference: network.rs:58-68. Only the head of each flow is deliverable.
+    Empty flows are removed so that removing a message is the exact inverse
+    of adding it (canonical form; network.rs:243-247).
+    """
+
+    __slots__ = ("flows",)
+
+    def __init__(self):
+        self.flows: dict = {}  # (src, dst) -> list of msgs, oldest first
+
+    def copy(self) -> "Ordered":
+        new = Ordered.__new__(Ordered)
+        new.flows = {flow: list(msgs) for flow, msgs in self.flows.items()}
+        return new
+
+    def send(self, envelope: Envelope) -> None:
+        self.flows.setdefault((envelope.src, envelope.dst), []).append(envelope.msg)
+
+    def _remove_first(self, envelope: Envelope) -> None:
+        flow = (envelope.src, envelope.dst)
+        msgs = self.flows.get(flow)
+        if msgs is None:
+            raise KeyError(f"flow not found: {flow!r}")
+        msgs.remove(envelope.msg)  # first occurrence
+        if not msgs:
+            del self.flows[flow]
+
+    def on_deliver(self, envelope: Envelope) -> None:
+        self._remove_first(envelope)
+
+    def on_drop(self, envelope: Envelope) -> None:
+        self._remove_first(envelope)
+
+    def iter_deliverable(self) -> Iterator[Envelope]:
+        for (src, dst) in sorted(self.flows):
+            yield Envelope(src, dst, self.flows[(src, dst)][0])
+
+    def iter_all(self) -> Iterator[Envelope]:
+        for (src, dst) in sorted(self.flows):
+            for msg in self.flows[(src, dst)]:
+                yield Envelope(src, dst, msg)
+
+    def fingerprint_key(self):
+        return {flow: tuple(msgs) for flow, msgs in self.flows.items()}
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Ordered) and self.flows == other.flows
+
+    __hash__ = Network.__hash__
+
+    def __repr__(self) -> str:
+        return f"Ordered({self.flows!r})"
+
+    def rewrite_with(self, plan) -> "Ordered":
+        new = Ordered()
+        for (src, dst), msgs in self.flows.items():
+            new.flows[(plan.rewrite(src), plan.rewrite(dst))] = [
+                plan.rewrite(m) for m in msgs
+            ]
+        return new

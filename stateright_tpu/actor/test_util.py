@@ -1,0 +1,120 @@
+"""Actor-test fixtures: the ping_pong system.
+
+Reference parity: src/actor/actor_test_util.rs. Two actors bounce a counter
+back and forth; each tracks how many messages it has processed. The model
+exercises every ActorModel feature knob: lossy networks, history hooks,
+boundaries, and all three property expectations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..core import Expectation
+from .base import Actor, Out
+from .ids import Id
+from .model import ActorModel
+
+
+@dataclass(frozen=True)
+class Ping:
+    value: int
+
+
+@dataclass(frozen=True)
+class Pong:
+    value: int
+
+
+class PingPongActor(Actor):
+    """State is the count of messages processed (a plain int)."""
+
+    def __init__(self, serve_to: Optional[Id] = None):
+        self.serve_to = serve_to
+
+    def on_start(self, id: Id, out: Out) -> int:
+        if self.serve_to is not None:
+            out.send(self.serve_to, Ping(0))
+        return 0
+
+    def on_msg(self, id: Id, state: int, src: Id, msg: Any, out: Out) -> Optional[int]:
+        if isinstance(msg, Pong) and state == msg.value:
+            out.send(src, Ping(msg.value + 1))
+            return state + 1
+        if isinstance(msg, Ping) and state == msg.value:
+            out.send(src, Pong(msg.value))
+            return state + 1
+        return None
+
+
+@dataclass
+class PingPongCfg:
+    maintains_history: bool = False
+    max_nat: int = 1
+
+
+def ping_pong_model(cfg: PingPongCfg) -> ActorModel:
+    """History is the pair (#messages in, #messages out).
+
+    Reference: actor_test_util.rs:60-126.
+    """
+
+    def record_msg_in(cfg, history, env):
+        if cfg.maintains_history:
+            msg_in, msg_out = history
+            return (msg_in + 1, msg_out)
+        return None
+
+    def record_msg_out(cfg, history, env):
+        if cfg.maintains_history:
+            msg_in, msg_out = history
+            return (msg_in, msg_out + 1)
+        return None
+
+    return (
+        ActorModel(cfg=cfg, init_history=(0, 0))
+        .actor(PingPongActor(serve_to=Id(1)))
+        .actor(PingPongActor())
+        .with_record_msg_in(record_msg_in)
+        .with_record_msg_out(record_msg_out)
+        .with_within_boundary(
+            lambda cfg, state: all(count <= cfg.max_nat for count in state.actor_states)
+        )
+        .property(
+            Expectation.ALWAYS,
+            "delta within 1",
+            lambda model, state: max(state.actor_states) - min(state.actor_states) <= 1,
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "can reach max",
+            lambda model, state: any(
+                count == model.cfg.max_nat for count in state.actor_states
+            ),
+        )
+        .property(
+            Expectation.EVENTUALLY,
+            "must reach max",
+            lambda model, state: any(
+                count == model.cfg.max_nat for count in state.actor_states
+            ),
+        )
+        .property(
+            Expectation.EVENTUALLY,
+            "must exceed max",  # falsifiable due to the boundary
+            lambda model, state: any(
+                count == model.cfg.max_nat + 1 for count in state.actor_states
+            ),
+        )
+        .property(
+            Expectation.ALWAYS,
+            "#in <= #out",
+            lambda model, state: state.history[0] <= state.history[1],
+        )
+        .property(
+            Expectation.EVENTUALLY,
+            "#out <= #in + 1",
+            lambda model, state: state.history[1] <= state.history[0] + 1,
+        )
+    )

@@ -1,0 +1,63 @@
+"""Per-actor named-timer sets (durations abstracted away for checking).
+
+Reference: `Timers` (src/actor/timers.rs). A timer is any canonically-
+fingerprintable tag; the checker explores a `Timeout` action for each set
+timer, so only *which* timers are pending matters, never when they fire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from ..fingerprint import canonical_bytes
+
+
+class Timers:
+    """The set of timers currently pending for one actor."""
+
+    __slots__ = ("_set",)
+
+    def __init__(self, timers=()):
+        self._set = set(timers)
+
+    def copy(self) -> "Timers":
+        return Timers(self._set)
+
+    def set(self, timer: Any) -> bool:
+        before = len(self._set)
+        self._set.add(timer)
+        return len(self._set) != before
+
+    def cancel(self, timer: Any) -> bool:
+        if timer in self._set:
+            self._set.remove(timer)
+            return True
+        return False
+
+    def cancel_all(self) -> None:
+        self._set.clear()
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(sorted(self._set, key=canonical_bytes))
+
+    def __len__(self) -> int:
+        return len(self._set)
+
+    def __contains__(self, timer: Any) -> bool:
+        return timer in self._set
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Timers) and self._set == other._set
+
+    def __hash__(self) -> int:
+        return hash(canonical_bytes(self.fingerprint_key()))
+
+    def __repr__(self) -> str:
+        return f"Timers({sorted(self._set, key=canonical_bytes)!r})"
+
+    def fingerprint_key(self):
+        return frozenset(self._set)
+
+    def rewrite_with(self, plan) -> "Timers":
+        # Timer tags never contain actor ids (reference: timers.rs:46-53).
+        return self.copy()

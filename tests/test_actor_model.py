@@ -1,0 +1,443 @@
+"""ActorModel tests. Mirrors src/actor/model.rs:765-1431 test module."""
+
+from typing import Optional
+
+import pytest
+
+from stateright_tpu import Expectation, PathRecorder, StateRecorder
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    ActorModelState,
+    Crash,
+    Deliver,
+    Drop,
+    Envelope,
+    Id,
+    Network,
+    Out,
+    RandomChoices,
+    Timers,
+    model_timeout,
+)
+from stateright_tpu.actor.test_util import Ping, PingPongCfg, Pong, ping_pong_model
+
+
+def states_and_network(states, envelopes, last_msg=None):
+    """Helper to build expected ping_pong system states (model.rs:779-796)."""
+    return ActorModelState(
+        actor_states=list(states),
+        network=Network.new_unordered_duplicating_with_last_msg(envelopes, last_msg),
+        timers_set=[Timers() for _ in states],
+        random_choices=[RandomChoices() for _ in states],
+        crashed=[False] * len(states),
+        history=(0, 0),
+    )
+
+
+def test_visits_expected_states():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    checker = (
+        ping_pong_model(PingPongCfg(maintains_history=False, max_nat=1))
+        .with_lossy_network(True)
+        .checker()
+        .visitor(recorder)
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 14
+
+    state_space = accessor()
+    assert len(state_space) == 14
+    p01 = Envelope(Id(0), Id(1), Ping(0))
+    q10 = Envelope(Id(1), Id(0), Pong(0))
+    p11 = Envelope(Id(0), Id(1), Ping(1))
+    expected = [
+        # When the network loses no messages...
+        states_and_network([0, 0], [p01]),
+        states_and_network([0, 1], [p01, q10], p01),
+        states_and_network([1, 1], [p01, q10, p11], q10),
+        # When the network loses the message for pinger-ponger state (0, 0)...
+        states_and_network([0, 0], []),
+        # When the network loses a message for pinger-ponger state (0, 1)...
+        states_and_network([0, 1], [q10], p01),
+        states_and_network([0, 1], [p01], p01),
+        states_and_network([0, 1], [], p01),
+        # When the network loses a message for pinger-ponger state (1, 1)...
+        states_and_network([1, 1], [q10, p11], q10),
+        states_and_network([1, 1], [p01, p11], q10),
+        states_and_network([1, 1], [p01, q10], q10),
+        states_and_network([1, 1], [p11], q10),
+        states_and_network([1, 1], [q10], q10),
+        states_and_network([1, 1], [p01], q10),
+        states_and_network([1, 1], [], q10),
+    ]
+    assert set(state_space) == set(expected)
+
+
+def test_no_op_depends_on_network():
+    class MyClient(Actor):
+        def __init__(self, server):
+            self.server = server
+
+        def on_start(self, id, out):
+            out.send(self.server, "Ignored")
+            out.send(self.server, "Interesting")
+            return "Awaiting an interesting message."
+
+        def on_msg(self, id, state, src, msg, out):
+            if msg == "Interesting":
+                return "Got an interesting message."
+            return None
+
+    class MyServer(MyClient):
+        def __init__(self):
+            pass
+
+        def on_start(self, id, out):
+            return "Awaiting an interesting message."
+
+    def build(network):
+        return (
+            ActorModel()
+            .actor(MyClient(server=Id(1)))
+            .actor(MyServer())
+            .with_lossy_network(False)
+            .with_init_network(network)
+            .property(Expectation.ALWAYS, "Check everything", lambda m, s: True)
+        )
+
+    # initial and delivery of Interesting
+    for name in ("unordered_duplicating", "unordered_nonduplicating"):
+        checker = build(Network.from_name(name)).checker().spawn_bfs().join()
+        assert checker.unique_state_count() == 2, name
+    # initial, delivery of Uninteresting, and subsequent delivery of Interesting
+    checker = build(Network.new_ordered()).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 3
+
+
+def test_maintains_fixed_delta_despite_lossy_duplicating_network():
+    checker = (
+        ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+        .with_lossy_network(True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4_094
+    checker.assert_no_discovery("delta within 1")
+
+
+def test_may_never_reach_max_on_lossy_network():
+    checker = (
+        ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+        .with_lossy_network(True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4_094
+    # can lose the first message and get stuck, for example
+    checker.assert_discovery(
+        "must reach max", [Drop(Envelope(Id(0), Id(1), Ping(0)))]
+    )
+
+
+def test_eventually_reaches_max_on_perfect_delivery_network():
+    checker = (
+        ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .with_lossy_network(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_can_reach_max():
+    checker = (
+        ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+        .with_lossy_network(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    assert checker.discovery("can reach max").last_state().actor_states == [4, 5]
+
+
+def test_might_never_reach_beyond_max():
+    # Exercises a falsifiable liveness property (eventually must exceed max),
+    # which fails due to the state-space boundary.
+    checker = (
+        ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .with_lossy_network(False)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    assert checker.discovery("must exceed max").last_state().actor_states == [5, 5]
+
+
+def test_handles_undeliverable_messages():
+    class Noop(Actor):
+        def on_start(self, id, out):
+            return ()
+
+    checker = (
+        ActorModel()
+        .actor(Noop())
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .with_init_network(
+            Network.new_unordered_duplicating([Envelope(Id(0), Id(99), ())])
+        )
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 1
+
+
+def test_handles_ordered_network_flag():
+    class OrderedNetworkActor(Actor):
+        def on_start(self, id, out):
+            if id == Id(0):
+                out.send(Id(1), 2)  # count down
+                out.send(Id(1), 1)
+            return ()
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + (msg,)
+
+    def recipient_states(network):
+        recorder, accessor = StateRecorder.new_with_accessor()
+        (
+            ActorModel()
+            .actor(OrderedNetworkActor())
+            .actor(OrderedNetworkActor())
+            .property(Expectation.ALWAYS, "", lambda m, s: True)
+            .with_init_network(network)
+            .checker()
+            .visitor(recorder)
+            .spawn_bfs()
+            .join()
+        )
+        return {s.actor_states[1] for s in accessor()}
+
+    # Fewer states if network is ordered.
+    assert recipient_states(Network.new_ordered()) == {(), (2,), (2, 1)}
+    # More states if network is not ordered.
+    assert recipient_states(Network.new_unordered_nonduplicating()) == {
+        (),
+        (1,),
+        (2,),
+        (1, 2),
+        (2, 1),
+    }
+
+
+def enumerate_action_sequences(lossy, init_network):
+    """Two actors; the first sends the same two messages; the second counts.
+
+    Reference: model.rs:1163-1215.
+    """
+
+    class A(Actor):
+        def on_start(self, id, out):
+            if id == Id(0):
+                out.send(Id(1), ())
+                out.send(Id(1), ())
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + 1
+
+    recorder, accessor = PathRecorder.new_with_accessor()
+    (
+        ActorModel()
+        .actor(A())
+        .actor(A())
+        .with_init_network(init_network)
+        .with_lossy_network(lossy)
+        .property(Expectation.ALWAYS, "force visiting all states", lambda m, s: True)
+        .with_within_boundary(lambda cfg, s: s.actor_states[1] < 4)
+        .checker()
+        .visitor(recorder)
+        .spawn_dfs()
+        .join()
+    )
+    return {tuple(p.into_actions()) for p in accessor()}
+
+
+def test_unordered_network_has_a_bug():
+    deliver = Deliver(src=Id(0), dst=Id(1), msg=())
+    drop = Drop(Envelope(src=Id(0), dst=Id(1), msg=()))
+
+    # Ordered networks can deliver/drop both messages.
+    ordered_lossless = enumerate_action_sequences(False, Network.new_ordered())
+    assert (deliver, deliver) in ordered_lossless
+    assert (deliver, deliver, deliver) not in ordered_lossless
+    ordered_lossy = enumerate_action_sequences(True, Network.new_ordered())
+    assert (deliver, deliver) in ordered_lossy
+    assert (deliver, drop) in ordered_lossy  # same state as "drop, deliver"
+    assert (drop, drop) in ordered_lossy
+
+    # Unordered duplicating networks can deliver/drop duplicates. Dropping
+    # means "never deliver again" (model.rs:1246-1249).
+    unord_dup_lossless = enumerate_action_sequences(
+        False, Network.new_unordered_duplicating()
+    )
+    assert (deliver, deliver, deliver) in unord_dup_lossless
+    unord_dup_lossy = enumerate_action_sequences(
+        True, Network.new_unordered_duplicating()
+    )
+    assert (deliver, deliver, deliver) in unord_dup_lossy
+    assert (deliver, deliver, drop) in unord_dup_lossy
+    assert (deliver, drop) in unord_dup_lossy
+    assert (drop,) in unord_dup_lossy
+    assert (drop, deliver) not in unord_dup_lossy
+
+    # Unordered nonduplicating networks can deliver/drop both messages.
+    unord_nondup_lossless = enumerate_action_sequences(
+        False, Network.new_unordered_nonduplicating()
+    )
+    assert (deliver, deliver) in unord_nondup_lossless
+    unord_nondup_lossy = enumerate_action_sequences(
+        True, Network.new_unordered_nonduplicating()
+    )
+    assert (deliver, drop) in unord_nondup_lossy
+    assert (drop, drop) in unord_nondup_lossy
+
+
+def test_resets_timer():
+    class TestActor(Actor):
+        def on_start(self, id, out):
+            out.set_timer("t", model_timeout())
+            return ()
+
+    # Init state with timer, followed by next state without timer.
+    checker = (
+        ActorModel()
+        .actor(TestActor())
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 2
+
+
+def test_choose_random():
+    class TestActor(Actor):
+        def on_start(self, id, out):
+            out.choose_random("key1", ["Choice1", "Choice2", "Choice3"])
+            return None
+
+        def on_random(self, id, state, random, out):
+            return random
+
+    # Init state with a random choice, followed by 3 possible next states.
+    checker = (
+        ActorModel()
+        .actor(TestActor())
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4
+
+
+def test_overwrite_choose_random():
+    class TestActor(Actor):
+        def on_start(self, id, out):
+            out.choose_random("key1", ["Choice1"])
+            out.choose_random("key2", ["Choice2", "Choice3"])
+            return ()
+
+        def on_random(self, id, state, random, out):
+            if random == "Choice1":
+                out.choose_random("key2", ["Choice3"])
+            return state + (random,)
+
+    #      /-> key1:Choice1 -> key2:Choice3
+    # Init --> key2:Choice2 -> key1:Choice1 -> key2:Choice3
+    #      \-> key2:Choice3 -> key1:Choice1 -> key2:Choice3
+    checker = (
+        ActorModel()
+        .actor(TestActor())
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 9
+
+
+def test_crash_requires_timer_or_random_to_differ():
+    # `crashed` is excluded from the fingerprint (model_state.rs:134-145), so
+    # crashing an actor with no timers/randoms dedups against its parent.
+    class Idle(Actor):
+        def on_start(self, id, out):
+            return ()
+
+    checker = (
+        ActorModel()
+        .actor(Idle())
+        .with_max_crashes(1)
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 1
+
+    class WithTimer(Actor):
+        def on_start(self, id, out):
+            out.set_timer("tick", model_timeout())
+            return ()
+
+    # init (timer set) -> timeout fires (timer gone) / crash (timers cleared);
+    # the crashed state and the post-timeout state collapse into one entry.
+    checker = (
+        ActorModel()
+        .actor(WithTimer())
+        .with_max_crashes(1)
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 2
+
+
+def test_script_actor_round_trip():
+    from stateright_tpu.actor import ScriptActor
+
+    class Echo(Actor):
+        def on_start(self, id, out):
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            out.send(src, msg)
+            return state + 1
+
+    checker = (
+        ActorModel()
+        .actor(ScriptActor([(Id(1), "a"), (Id(1), "b")]))
+        .actor(Echo())
+        .with_init_network(Network.new_ordered())
+        .property(
+            Expectation.SOMETIMES,
+            "script finishes",
+            lambda m, s: s.actor_states[0] == 2 and s.actor_states[1] == 2,
+        )
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
