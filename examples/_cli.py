@@ -1,0 +1,67 @@
+"""Shared CLI plumbing for the example programs.
+
+Role parity with the per-example pico-args CLIs in the reference
+(e.g. examples/paxos.rs:354-510): each example exposes `check` /
+`check-dfs` / `check-simulation` / `explore` / `spawn` subcommands with
+positional arguments for problem size and network semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Optional
+
+from stateright_tpu import WriteReporter
+from stateright_tpu.actor import Network
+
+
+def _thread_count() -> int:
+    return os.cpu_count() or 1
+
+
+def example_main(
+    argv,
+    name: str,
+    build_model: Callable,
+    default_client_count: int = 2,
+    default_network: str = "unordered_nonduplicating",
+    spawn_info: Optional[Callable] = None,
+):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    subcommand = argv[0] if argv else "check"
+    rest = argv[1:]
+
+    def arg(i, default):
+        return rest[i] if len(rest) > i else default
+
+    if subcommand in ("check", "check-bfs", "check-dfs", "check-simulation"):
+        client_count = int(arg(0, default_client_count))
+        network = Network.from_name(arg(1, default_network))
+        print(f"Model checking {name} with {client_count} clients.")
+        builder = build_model(client_count, network).checker().threads(_thread_count())
+        if subcommand == "check-dfs":
+            checker = builder.spawn_dfs()
+        elif subcommand == "check-simulation":
+            checker = builder.timeout(10.0).spawn_simulation(seed=0)
+        else:
+            checker = builder.spawn_bfs()
+        checker.report(WriteReporter(sys.stdout))
+    elif subcommand == "explore":
+        client_count = int(arg(0, default_client_count))
+        address = arg(1, "localhost:3000")
+        network = Network.from_name(arg(2, default_network))
+        print(
+            f"Exploring state space for {name} with {client_count} clients on {address}."
+        )
+        build_model(client_count, network).checker().threads(_thread_count()).serve(
+            address
+        )
+    elif subcommand == "spawn":
+        if spawn_info is None:
+            print(f"{name} does not support the spawn subcommand.")
+            raise SystemExit(1)
+        spawn_info()
+    else:
+        print(f"Usage: {sys.argv[0]} [check|check-dfs|check-simulation|explore|spawn]")
+        raise SystemExit(1)

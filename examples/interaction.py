@@ -1,0 +1,148 @@
+"""Modeling external input: a client actor drives a counter service.
+
+Shows how to model user interaction (or any external stimulus) with actors
+whose states do not evolve autonomously: timers trigger the client's
+increment request and subsequent query, and an `eventually` property checks
+the client observes success.
+
+Reference parity: examples/interaction.rs. The reference needs the
+`choice!` machinery to mix actor types in one model; Python actor lists are
+heterogeneous natively, so `Client` and `Counter` are added directly.
+
+Usage::
+
+    python examples/interaction.py check
+    python examples/interaction.py explore [ADDRESS]
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, replace
+from typing import Any
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from stateright_tpu import Expectation, WriteReporter
+from stateright_tpu.actor import Actor, ActorModel, Id, Out, model_timeout
+
+
+@dataclass(frozen=True)
+class IncrementRequest:
+    amount: int
+
+
+@dataclass(frozen=True)
+class ReportRequest:
+    pass
+
+
+@dataclass(frozen=True)
+class ReplyCount:
+    count: int
+
+
+@dataclass(frozen=True)
+class CounterState:
+    addr: Id
+    counter: int
+
+
+@dataclass(frozen=True)
+class InputState:
+    wait_cycles: int  # only for observing system evolution in the explorer
+    success: bool
+
+
+class Counter(Actor):
+    """Reference: Counter (interaction.rs:100-133)."""
+
+    def __init__(self, initial_state: CounterState):
+        self.initial_state = initial_state
+
+    def name(self) -> str:
+        return "Counter"
+
+    def on_start(self, id: Id, out: Out) -> CounterState:
+        return self.initial_state
+
+    def on_msg(self, id: Id, state: CounterState, src: Id, msg: Any, out: Out):
+        if isinstance(msg, IncrementRequest):
+            return replace(state, counter=state.counter + msg.amount)
+        if isinstance(msg, ReportRequest):
+            out.send(src, ReplyCount(state.counter))
+            return None
+        return None
+
+
+class Client(Actor):
+    """Reference: Client (interaction.rs:135-203)."""
+
+    def __init__(self, threshold: int, counter_addr: Id):
+        self.threshold = threshold
+        self.counter_addr = counter_addr
+
+    def name(self) -> str:
+        return "Client"
+
+    def on_start(self, id: Id, out: Out) -> InputState:
+        out.set_timer("ClientInput", model_timeout())
+        return InputState(wait_cycles=0, success=False)
+
+    def on_msg(self, id: Id, state: InputState, src: Id, msg: Any, out: Out):
+        if isinstance(msg, ReplyCount) and msg.count >= self.threshold:
+            return replace(state, success=True)
+        return None
+
+    def on_timeout(self, id: Id, state: InputState, timer: Any, out: Out):
+        if timer == "ClientInput":
+            # Query only after the increment has been requested.
+            out.set_timer("ClientQuery", model_timeout())
+            out.send(self.counter_addr, IncrementRequest(3))
+            return replace(state, wait_cycles=state.wait_cycles + 1)
+        if timer == "ClientQuery":
+            out.send(self.counter_addr, ReportRequest())
+            return replace(state, wait_cycles=state.wait_cycles + 1)
+        return None
+
+
+def interaction_model() -> ActorModel:
+    return (
+        ActorModel(init_history=0)
+        .actor(Client(threshold=3, counter_addr=Id(1)))
+        .actor(Counter(CounterState(addr=Id(1), counter=0)))
+        .property(
+            Expectation.EVENTUALLY,
+            "success",
+            lambda model, state: any(
+                isinstance(s, InputState) and s.success for s in state.actor_states
+            ),
+        )
+    )
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    subcommand = argv[0] if argv else "check"
+    # target_max_depth bounds the very loosely bounded state space
+    # (interaction.rs:43).
+    if subcommand == "check":
+        checker = (
+            interaction_model()
+            .checker()
+            .target_max_depth(30)
+            .spawn_bfs()
+            .report(WriteReporter(sys.stdout))
+        )
+        checker.assert_properties()
+    elif subcommand == "explore":
+        address = argv[1] if len(argv) > 1 else "localhost:3000"
+        interaction_model().checker().target_max_depth(30).serve(address)
+    else:
+        print("USAGE:")
+        print("  python examples/interaction.py check")
+        print("  python examples/interaction.py explore [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+"""ABD linearizable register: quorum-replicated shared memory.
+
+Implements the algorithm from "Sharing Memory Robustly in Message-Passing
+Systems" by Attiya, Bar-Noy, and Dolev: Phase 1 queries a quorum for the
+highest (logical-clock, id) sequencer; Phase 2 records the chosen
+value/sequencer at a quorum before replying.
+
+Reference parity: examples/linearizable-register.rs. Golden: 544 unique
+states with 2 clients and 2 servers on an unordered non-duplicating
+network (linearizable-register.rs:287).
+
+Usage::
+
+    python examples/linearizable_register.py check [CLIENT_COUNT] [NETWORK]
+    python examples/linearizable_register.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, replace
+from typing import Any, FrozenSet, Optional, Tuple
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
+from stateright_tpu.actor.register import (
+    Get,
+    GetOk,
+    Internal,
+    Put,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+)
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.register import Register
+
+Seq = Tuple[int, Id]  # (logical clock, actor id) — globally unique
+
+
+# -- internal protocol (linearizable-register.rs:28-34) ----------------------
+
+@dataclass(frozen=True)
+class Query:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class AckQuery:
+    request_id: int
+    seq: Seq
+    value: Any
+
+
+@dataclass(frozen=True)
+class Record:
+    request_id: int
+    seq: Seq
+    value: Any
+
+
+@dataclass(frozen=True)
+class AckRecord:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class Phase1:
+    request_id: int
+    requester_id: Id
+    write: Optional[Any]  # None for reads
+    responses: Tuple[Tuple[Id, Tuple[Seq, Any]], ...]
+
+
+@dataclass(frozen=True)
+class Phase2:
+    request_id: int
+    requester_id: Id
+    # An explicit flag rather than a `read is None` sentinel: an empty
+    # register legitimately reads as None, which must still GetOk.
+    is_read: bool
+    read: Optional[Any]
+    acks: FrozenSet[Id]
+
+
+@dataclass(frozen=True)
+class AbdState:
+    seq: Seq
+    val: Any
+    phase: Optional[Any]  # Phase1 | Phase2 | None
+
+
+class AbdActor(Actor):
+    """Reference: AbdActor (linearizable-register.rs:60-210)."""
+
+    def __init__(self, peers):
+        self.peers = list(peers)
+
+    def name(self) -> str:
+        return "ABD Server"
+
+    def on_start(self, id: Id, out: Out) -> AbdState:
+        return AbdState(seq=(0, id), val=None, phase=None)
+
+    def on_msg(self, id: Id, state: AbdState, src: Id, msg: Any, out: Out):
+        if isinstance(msg, (Put, Get)) and state.phase is None:
+            write = msg.value if isinstance(msg, Put) else None
+            out.broadcast(self.peers, Internal(Query(msg.request_id)))
+            return replace(
+                state,
+                phase=Phase1(
+                    request_id=msg.request_id,
+                    requester_id=src,
+                    write=write,
+                    responses=((id, (state.seq, state.val)),),
+                ),
+            )
+
+        if isinstance(msg, Internal):
+            inner = msg.msg
+            if isinstance(inner, Query):
+                out.send(src, Internal(AckQuery(inner.request_id, state.seq, state.val)))
+                return None
+
+            if (
+                isinstance(inner, AckQuery)
+                and isinstance(state.phase, Phase1)
+                and state.phase.request_id == inner.request_id
+            ):
+                phase = state.phase
+                responses = dict(phase.responses)
+                responses[src] = (inner.seq, inner.value)
+                if len(responses) < majority(len(self.peers) + 1):
+                    return replace(
+                        state, phase=replace(phase, responses=tuple(sorted(responses.items())))
+                    )
+                # Quorum reached; move to phase 2. Sequencers are distinct,
+                # so max-by-seq is deterministic (linearizable-register.rs:136-140).
+                seq, val = max(responses.values(), key=lambda sv: sv[0])
+                is_read = phase.write is None
+                read = None
+                if is_read:
+                    read = val
+                else:
+                    seq = (seq[0] + 1, id)
+                    val = phase.write
+                out.broadcast(self.peers, Internal(Record(phase.request_id, seq, val)))
+                new_seq, new_val = (
+                    (seq, val) if seq > state.seq else (state.seq, state.val)
+                )  # self-send Record
+                return replace(
+                    state,
+                    seq=new_seq,
+                    val=new_val,
+                    phase=Phase2(
+                        request_id=phase.request_id,
+                        requester_id=phase.requester_id,
+                        is_read=is_read,
+                        read=read,
+                        acks=frozenset({id}),  # self-send AckRecord
+                    ),
+                )
+
+            if isinstance(inner, Record):
+                out.send(src, Internal(AckRecord(inner.request_id)))
+                if inner.seq > state.seq:
+                    return replace(state, seq=inner.seq, val=inner.value)
+                return None
+
+            if (
+                isinstance(inner, AckRecord)
+                and isinstance(state.phase, Phase2)
+                and state.phase.request_id == inner.request_id
+                and src not in state.phase.acks
+            ):
+                phase = state.phase
+                acks = phase.acks | {src}
+                if len(acks) < majority(len(self.peers) + 1):
+                    return replace(state, phase=replace(phase, acks=acks))
+                if phase.is_read:
+                    out.send(phase.requester_id, GetOk(phase.request_id, phase.read))
+                else:
+                    out.send(phase.requester_id, PutOk(phase.request_id))
+                return replace(state, phase=None)
+
+        return None
+
+
+def abd_model(
+    client_count: int, server_count: int = 2, network: Optional[Network] = None
+) -> ActorModel:
+    """Reference: AbdModelCfg::into_model (linearizable-register.rs:215-255)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    def value_chosen(model, state) -> bool:
+        return any(
+            isinstance(env.msg, GetOk) and env.msg.value is not None
+            for env in state.network.iter_deliverable()
+        )
+
+    return (
+        ActorModel(
+            cfg=(client_count, server_count),
+            init_history=LinearizabilityTester(Register(None)),
+        )
+        .add_actors(
+            AbdActor(model_peers(i, server_count)) for i in range(server_count)
+        )
+        .add_actors(
+            RegisterClient(put_count=1, server_count=server_count)
+            for _ in range(client_count)
+        )
+        .with_init_network(network)
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda model, state: state.history.serialized_history() is not None,
+        )
+        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        .with_record_msg_in(record_returns)
+        .with_record_msg_out(record_invocations)
+    )
+
+
+def main(argv=None):
+    from examples._cli import example_main
+
+    example_main(
+        argv,
+        name="a linearizable register",
+        build_model=lambda client_count, network: abd_model(client_count, 2, network),
+        default_client_count=2,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Last-write-wins register: a state-based CRDT with modeled clock drift.
+
+Each actor nondeterministically (via `choose_random`) sets a value or
+drifts its local clock, broadcasting its register state; receivers merge by
+(timestamp, updater_id). The "eventually consistent" property asserts that
+whenever the network is empty, all replicas agree — a CRDT-style quiescent
+consistency, deliberately expressed as an `always` over quiescent states
+rather than an `eventually` (lww-register.rs:163-181).
+
+Reference parity: examples/lww-register.rs.
+
+Usage::
+
+    python examples/lww_register.py check [CLIENT_COUNT] [DEPTH]
+    python examples/lww_register.py explore [CLIENT_COUNT] [ADDRESS]
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, replace
+from typing import Any, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from stateright_tpu import Expectation, WriteReporter
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
+
+
+@dataclass(frozen=True)
+class LwwRegister:
+    """Reference: LwwRegister (lww-register.rs:14-34)."""
+
+    value: str
+    timestamp: int
+    updater_id: int
+
+    @staticmethod
+    def merge(a: "LwwRegister", b: "LwwRegister") -> "LwwRegister":
+        return a if (a.timestamp, a.updater_id) > (b.timestamp, b.updater_id) else b
+
+
+@dataclass(frozen=True)
+class SetValue:
+    value: str
+
+
+@dataclass(frozen=True)
+class SetTime:
+    time: int
+
+
+@dataclass(frozen=True)
+class LwwActorState:
+    register: Optional[LwwRegister]
+    local_clock: int
+    maximum_used_clock: int
+
+
+class LwwActor(Actor):
+    """Reference: LwwActor (lww-register.rs:65-146)."""
+
+    VALUES = ("A", "B", "C")
+
+    def __init__(self, peers):
+        self.peers = list(peers)
+
+    def name(self) -> str:
+        return "LWW"
+
+    def _populate_choices(self, out: Out, time: int) -> None:
+        out.choose_random(
+            "node_action",
+            [SetValue(v) for v in self.VALUES]
+            + [SetTime(time + 1), SetTime(max(0, time - 1))],
+        )
+
+    def on_start(self, id: Id, out: Out) -> LwwActorState:
+        state = LwwActorState(register=None, local_clock=1000, maximum_used_clock=1000)
+        self._populate_choices(out, state.local_clock)
+        return state
+
+    def on_random(self, id: Id, state: LwwActorState, random: Any, out: Out):
+        if isinstance(random, SetValue):
+            if state.register is not None:
+                # Ensure the clock value is unique per node.
+                clock_value = max(state.local_clock, state.maximum_used_clock + 1)
+                register = LwwRegister(random.value, clock_value, int(id))
+                new_state = replace(
+                    state, register=register, maximum_used_clock=clock_value
+                )
+            else:
+                register = LwwRegister(random.value, state.local_clock, int(id))
+                new_state = replace(state, register=register)
+            out.broadcast(self.peers, register)
+            self._populate_choices(out, new_state.local_clock)
+            return new_state
+        if isinstance(random, SetTime):
+            new_state = replace(state, local_clock=random.time)
+            self._populate_choices(out, new_state.local_clock)
+            return new_state
+        return None
+
+    def on_msg(self, id: Id, state: LwwActorState, src: Id, msg: Any, out: Out):
+        if state.register is not None:
+            return replace(state, register=LwwRegister.merge(state.register, msg))
+        return replace(state, register=msg)
+
+
+def lww_model(actor_count: int) -> ActorModel:
+    """Reference: build_checker (lww-register.rs:148-183)."""
+    peers = [Id(i) for i in range(actor_count)]
+
+    def eventually_consistent(model, state) -> bool:
+        # CRDT eventual consistency: replicas agree whenever no messages are
+        # in flight. Transient agreement before quiescence doesn't count.
+        if len(state.network) == 0:
+            registers = [s.register for s in state.actor_states]
+            return all(r == registers[0] for r in registers)
+        return True
+
+    model = ActorModel()
+    for _ in range(actor_count):
+        model.actor(LwwActor(peers))
+    return model.with_init_network(
+        Network.new_unordered_nonduplicating()
+    ).property(Expectation.ALWAYS, "eventually consistent", eventually_consistent)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    subcommand = argv[0] if argv else "check"
+
+    def arg(i, default):
+        return argv[1 + i] if len(argv) > 1 + i else default
+
+    if subcommand == "check":
+        actor_count = int(arg(0, 2))
+        depth = int(arg(1, 8))
+        (
+            lww_model(actor_count)
+            .checker()
+            .target_max_depth(depth)
+            .spawn_dfs()
+            .join_and_report(WriteReporter(sys.stdout))
+        )
+    elif subcommand == "explore":
+        actor_count = int(arg(0, 2))
+        address = arg(1, "localhost:3000")
+        print(
+            f"Exploring state space for last-writer-wins register with "
+            f"{actor_count} clients on {address}."
+        )
+        lww_model(actor_count).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  python examples/lww_register.py check [CLIENT_COUNT] [DEPTH]")
+        print("  python examples/lww_register.py explore [CLIENT_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

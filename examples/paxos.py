@@ -1,0 +1,253 @@
+"""Single Decree Paxos, model-checked against a linearizability tester.
+
+A cluster of servers that never disagrees on a value: Phase 1 performs
+leadership handoff via ballots (`Prepare`/`Prepared`), Phase 2 drives a
+proposal to a quorum (`Accept`/`Accepted`/`Decided`). Each client Put starts
+a new term.
+
+Reference parity: examples/paxos.rs (actor at paxos.rs:106-254, model at
+256-298, CLI at 354-510). Golden: 16,668 unique states with 2 clients and
+3 servers on an unordered non-duplicating network (paxos.rs:327).
+
+Usage::
+
+    python examples/paxos.py check [CLIENT_COUNT] [NETWORK]
+    python examples/paxos.py check-dfs [CLIENT_COUNT] [NETWORK]
+    python examples/paxos.py check-simulation [CLIENT_COUNT] [NETWORK]
+    python examples/paxos.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]
+    python examples/paxos.py spawn
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, replace
+from typing import Any, Dict, FrozenSet, Optional, Tuple
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
+from stateright_tpu.actor.register import (
+    Get,
+    GetOk,
+    Internal,
+    Put,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+)
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.register import Register
+
+Ballot = Tuple[int, Id]  # (round, proposer)
+Proposal = Tuple[int, Id, str]  # (request_id, requester, value)
+
+
+# -- internal protocol messages (paxos.rs:67-89) -----------------------------
+
+@dataclass(frozen=True)
+class Prepare:
+    ballot: Ballot
+
+
+@dataclass(frozen=True)
+class Prepared:
+    ballot: Ballot
+    last_accepted: Optional[Tuple[Ballot, Proposal]]
+
+
+@dataclass(frozen=True)
+class Accept:
+    ballot: Ballot
+    proposal: Proposal
+
+
+@dataclass(frozen=True)
+class Accepted:
+    ballot: Ballot
+
+
+@dataclass(frozen=True)
+class Decided:
+    ballot: Ballot
+    proposal: Proposal
+
+
+@dataclass(frozen=True)
+class PaxosState:
+    """Reference: PaxosState (paxos.rs:92-103)."""
+
+    # shared state
+    ballot: Ballot
+    # leader state
+    proposal: Optional[Proposal]
+    prepares: Tuple[Tuple[Id, Optional[Tuple[Ballot, Proposal]]], ...]
+    accepts: FrozenSet[Id]
+    # acceptor state
+    accepted: Optional[Tuple[Ballot, Proposal]]
+    is_decided: bool
+
+
+def _accepted_sort_key(entry: Optional[Tuple[Ballot, Proposal]]):
+    # None sorts below every accepted proposal (Rust: Option's Ord).
+    return (0,) if entry is None else (1, entry)
+
+
+class PaxosActor(Actor):
+    """Reference: PaxosActor (paxos.rs:106-254)."""
+
+    def __init__(self, peer_ids):
+        self.peer_ids = list(peer_ids)
+
+    def name(self) -> str:
+        return "Paxos Server"
+
+    def on_start(self, id: Id, out: Out) -> PaxosState:
+        return PaxosState(
+            ballot=(0, Id(0)),
+            proposal=None,
+            prepares=(),
+            accepts=frozenset(),
+            accepted=None,
+            is_decided=False,
+        )
+
+    def on_msg(
+        self, id: Id, state: PaxosState, src: Id, msg: Any, out: Out
+    ) -> Optional[PaxosState]:
+        if state.is_decided:
+            if isinstance(msg, Get):
+                # We can't reply for undecided: a value may have been decided
+                # elsewhere with delivery pending (paxos.rs:146-151).
+                _ballot, (_req_id, _src, value) = state.accepted
+                out.send(src, GetOk(msg.request_id, value))
+            return None
+
+        if isinstance(msg, Put) and state.proposal is None:
+            ballot = (state.ballot[0] + 1, id)  # simulate Prepare self-send
+            out.broadcast(self.peer_ids, Internal(Prepare(ballot)))
+            return replace(
+                state,
+                proposal=(msg.request_id, src, msg.value),
+                prepares=((id, state.accepted),),  # simulate Prepared self-send
+                accepts=frozenset(),
+                ballot=ballot,
+            )
+
+        if isinstance(msg, Internal):
+            inner = msg.msg
+            if isinstance(inner, Prepare) and state.ballot < inner.ballot:
+                out.send(
+                    src, Internal(Prepared(inner.ballot, last_accepted=state.accepted))
+                )
+                return replace(state, ballot=inner.ballot)
+
+            if isinstance(inner, Prepared) and inner.ballot == state.ballot:
+                prepares = dict(state.prepares)
+                prepares[src] = inner.last_accepted
+                new_state = replace(state, prepares=tuple(sorted(prepares.items())))
+                if len(prepares) == majority(len(self.peer_ids) + 1):
+                    # Leadership handoff: favor the most recently accepted
+                    # proposal from the prepare quorum, else the client's
+                    # (paxos.rs:195-216).
+                    best = max(prepares.values(), key=_accepted_sort_key)
+                    proposal = best[1] if best is not None else state.proposal
+                    new_state = replace(
+                        new_state,
+                        proposal=proposal,
+                        accepted=(inner.ballot, proposal),  # Accept self-send
+                        accepts=frozenset({id}),  # Accepted self-send
+                    )
+                    out.broadcast(
+                        self.peer_ids, Internal(Accept(inner.ballot, proposal))
+                    )
+                return new_state
+
+            if isinstance(inner, Accept) and state.ballot <= inner.ballot:
+                out.send(src, Internal(Accepted(inner.ballot)))
+                return replace(
+                    state,
+                    ballot=inner.ballot,
+                    accepted=(inner.ballot, inner.proposal),
+                )
+
+            if isinstance(inner, Accepted) and inner.ballot == state.ballot:
+                accepts = state.accepts | {src}
+                new_state = replace(state, accepts=accepts)
+                if len(accepts) == majority(len(self.peer_ids) + 1):
+                    new_state = replace(new_state, is_decided=True)
+                    proposal = state.proposal
+                    out.broadcast(
+                        self.peer_ids, Internal(Decided(inner.ballot, proposal))
+                    )
+                    request_id, requester_id, _value = proposal
+                    out.send(requester_id, PutOk(request_id))
+                return new_state
+
+            if isinstance(inner, Decided):
+                return replace(
+                    state,
+                    ballot=inner.ballot,
+                    accepted=(inner.ballot, inner.proposal),
+                    is_decided=True,
+                )
+
+        return None
+
+
+def paxos_model(
+    client_count: int, server_count: int = 3, network: Optional[Network] = None
+) -> ActorModel:
+    """Reference: PaxosModelCfg::into_model (paxos.rs:256-298)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    def value_chosen(model, state) -> bool:
+        for env in state.network.iter_deliverable():
+            if isinstance(env.msg, GetOk) and env.msg.value is not None:
+                return True
+        return False
+
+    return (
+        ActorModel(
+            cfg=(client_count, server_count),
+            init_history=LinearizabilityTester(Register(None)),
+        )
+        .add_actors(
+            PaxosActor(model_peers(i, server_count)) for i in range(server_count)
+        )
+        .add_actors(
+            RegisterClient(put_count=1, server_count=server_count)
+            for _ in range(client_count)
+        )
+        .with_init_network(network)
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda model, state: state.history.serialized_history() is not None,
+        )
+        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        .with_record_msg_in(record_returns)
+        .with_record_msg_out(record_invocations)
+    )
+
+
+def main(argv=None):
+    from examples._cli import example_main
+
+    example_main(
+        argv,
+        name="Single Decree Paxos",
+        build_model=lambda client_count, network: paxos_model(
+            client_count, 3, network
+        ),
+        default_client_count=2,
+        default_network="unordered_nonduplicating",
+        spawn_info=None,
+    )
+
+
+if __name__ == "__main__":
+    main()

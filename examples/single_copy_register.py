@@ -1,0 +1,127 @@
+"""A rewritable single-copy register per server — no consensus.
+
+Linearizable with one server; with two or more, a stale read breaks
+linearizability and the checker finds the counterexample.
+
+Reference parity: examples/single-copy-register.rs. Goldens: 93 unique
+states (2 clients, 1 server, DFS) and 20 states with the linearizability
+counterexample (2 clients, 2 servers, BFS).
+
+Usage::
+
+    python examples/single_copy_register.py check [CLIENT_COUNT] [NETWORK]
+    python examples/single_copy_register.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]
+    python examples/single_copy_register.py spawn
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
+from stateright_tpu.actor.register import (
+    Get,
+    GetOk,
+    Put,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+)
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.register import Register
+
+
+class SingleCopyActor(Actor):
+    """State is just the stored value. Reference: single-copy-register.rs:18-47."""
+
+    def name(self) -> str:
+        return "Server"
+
+    def on_start(self, id: Id, out: Out):
+        return None  # empty register
+
+    def on_msg(self, id: Id, state: Any, src: Id, msg: Any, out: Out) -> Optional[Any]:
+        if isinstance(msg, Put):
+            out.send(src, PutOk(msg.request_id))
+            return msg.value
+        if isinstance(msg, Get):
+            out.send(src, GetOk(msg.request_id, state))
+            return None
+        return None
+
+
+def single_copy_model(
+    client_count: int, server_count: int = 1, network: Optional[Network] = None
+) -> ActorModel:
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    def value_chosen(model, state) -> bool:
+        return any(
+            isinstance(env.msg, GetOk) and env.msg.value is not None
+            for env in state.network.iter_deliverable()
+        )
+
+    return (
+        ActorModel(
+            cfg=(client_count, server_count),
+            init_history=LinearizabilityTester(Register(None)),
+        )
+        .add_actors(SingleCopyActor() for _ in range(server_count))
+        .add_actors(
+            RegisterClient(put_count=1, server_count=server_count)
+            for _ in range(client_count)
+        )
+        .with_init_network(network)
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda model, state: state.history.serialized_history() is not None,
+        )
+        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        .with_record_msg_in(record_returns)
+        .with_record_msg_out(record_invocations)
+    )
+
+
+def spawn_info():
+    from stateright_tpu.actor.spawn import (
+        json_serializer,
+        make_json_deserializer,
+        spawn,
+    )
+
+    port = 3000
+    print("  A server that implements a single-copy register.")
+    print("  You can monitor and interact using tcpdump and netcat:")
+    print(f"$ nc -u localhost {port}")
+    print('["Put", 1, "X"]')
+    print('["Get", 2]')
+    spawn(
+        json_serializer,
+        make_json_deserializer(Put, Get, PutOk, GetOk),
+        [(Id.from_addr("127.0.0.1", port), SingleCopyActor())],
+    )
+
+
+def main(argv=None):
+    from examples._cli import example_main
+
+    example_main(
+        argv,
+        name="a single-copy register",
+        build_model=lambda client_count, network: single_copy_model(
+            client_count, 1, network
+        ),
+        default_client_count=2,
+        spawn_info=spawn_info,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Named-timer demo: pingers that fire Even/Odd/NoOp timers.
+
+Reference parity: examples/timers.rs. Each actor sets three named timers on
+start; Even/Odd timeouts re-arm themselves and ping even/odd peers; NoOp
+only re-arms itself (and is therefore pruned as a no-op by the checker).
+
+Usage::
+
+    python examples/timers.py check [SERVER_COUNT] [NETWORK]
+    python examples/timers.py explore [SERVER_COUNT] [ADDRESS] [NETWORK]
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, replace
+from typing import Any, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    Id,
+    Network,
+    Out,
+    model_peers,
+    model_timeout,
+)
+
+
+@dataclass(frozen=True)
+class Ping:
+    pass
+
+
+@dataclass(frozen=True)
+class Pong:
+    pass
+
+
+@dataclass(frozen=True)
+class PingerState:
+    sent: int
+    received: int
+
+
+class PingerActor(Actor):
+    """Reference: PingerActor (timers.rs:28-98)."""
+
+    TIMERS = ("Even", "Odd", "NoOp")
+
+    def __init__(self, peer_ids):
+        self.peer_ids = list(peer_ids)
+
+    def on_start(self, id: Id, out: Out) -> PingerState:
+        for timer in self.TIMERS:
+            out.set_timer(timer, model_timeout())
+        return PingerState(sent=0, received=0)
+
+    def on_msg(self, id: Id, state: PingerState, src: Id, msg: Any, out: Out):
+        if isinstance(msg, Ping):
+            out.send(src, Pong())
+            return None
+        if isinstance(msg, Pong):
+            return replace(state, received=state.received + 1)
+        return None
+
+    def on_timeout(self, id: Id, state: PingerState, timer: Any, out: Out):
+        out.set_timer(timer, model_timeout())
+        if timer == "NoOp":
+            return None
+        parity = 0 if timer == "Even" else 1
+        sent = state.sent
+        for dst in self.peer_ids:
+            if int(dst) % 2 == parity:
+                sent += 1
+                out.send(dst, Ping())
+        return replace(state, sent=sent) if sent != state.sent else None
+
+
+def timers_model(server_count: int, network: Optional[Network] = None) -> ActorModel:
+    if network is None:
+        network = Network.new_unordered_duplicating()
+    return (
+        ActorModel()
+        .add_actors(
+            PingerActor(model_peers(i, server_count)) for i in range(server_count)
+        )
+        .with_init_network(network)
+        .with_within_boundary(
+            lambda cfg, state: all(
+                s.sent <= 2 and s.received <= 2 for s in state.actor_states
+            )
+        )
+        .property(Expectation.ALWAYS, "true", lambda m, s: True)
+    )
+
+
+def main(argv=None):
+    from examples._cli import example_main
+
+    example_main(
+        argv,
+        name="timers",
+        build_model=lambda count, network: timers_model(count, network),
+        default_client_count=2,
+        default_network="unordered_duplicating",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Ordered reliable link (ORL): middleware adding seq/ack/resend reliability.
+
+Reference parity: src/actor/ordered_reliable_link.rs — a "perfect link" with
+per-(src, dst) ordering, based on Cachin/Guerraoui/Rodrigues. Wraps any
+actor so that its sends are sequenced, acked, resent on a timer, and
+deduplicated on receipt. Assumes actors never restart (the sequencer state
+is in-memory only; ordered_reliable_link.rs:9-10).
+
+Deviation from the reference, by design: the reference's `on_timeout` for
+user timers drops the wrapped actor's revised state on the floor (an
+upstream bug at ordered_reliable_link.rs:177-188 — the `Cow::Owned` branch
+is missing); here the revised state is applied.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+from .base import Actor, CancelTimer, ChooseRandom, Out, Send, SetTimer, is_no_op
+from .ids import Id
+
+
+@dataclass(frozen=True)
+class DeliverMsg:
+    """A sequenced payload. Reference: MsgWrapper::Deliver."""
+
+    seq: int
+    msg: Any
+
+
+@dataclass(frozen=True)
+class AckMsg:
+    """Acknowledges receipt of a sequenced payload. Reference: MsgWrapper::Ack."""
+
+    seq: int
+
+
+@dataclass(frozen=True)
+class NetworkTimer:
+    """The resend timer. Reference: TimerWrapper::Network."""
+
+
+@dataclass(frozen=True)
+class UserTimer:
+    """A wrapped actor's own timer. Reference: TimerWrapper::User."""
+
+    timer: Any
+
+
+@dataclass(frozen=True)
+class LinkState:
+    """ORL bookkeeping around the wrapped actor's state.
+
+    Reference: StateWrapper (ordered_reliable_link.rs:50-60).
+    `msgs_pending_ack` maps seq -> (dst, msg); `last_delivered_seqs` maps
+    src -> highest seq delivered (for receive-side dedup).
+    """
+
+    next_send_seq: int
+    msgs_pending_ack: Tuple[Tuple[int, Tuple[Id, Any]], ...]
+    last_delivered_seqs: Tuple[Tuple[Id, int], ...]
+    wrapped_state: Any
+
+    def pending(self) -> dict:
+        return dict(self.msgs_pending_ack)
+
+    def delivered(self) -> dict:
+        return dict(self.last_delivered_seqs)
+
+
+def _freeze(d: dict) -> tuple:
+    return tuple(sorted(d.items()))
+
+
+class OrderedReliableLink(Actor):
+    """Wraps `wrapped_actor` with ordering/reliability/dedup logic.
+
+    Reference: ActorWrapper (ordered_reliable_link.rs:28-35).
+    """
+
+    def __init__(self, wrapped_actor: Actor, resend_interval: Tuple[float, float] = (1.0, 2.0)):
+        self.wrapped_actor = wrapped_actor
+        self.resend_interval = resend_interval
+
+    @staticmethod
+    def with_default_timeout(wrapped_actor: Actor) -> "OrderedReliableLink":
+        return OrderedReliableLink(wrapped_actor)
+
+    def name(self) -> str:
+        return self.wrapped_actor.name()
+
+    # -- event handlers ------------------------------------------------------
+
+    def on_start(self, id: Id, out: Out) -> LinkState:
+        out.set_timer(NetworkTimer(), self.resend_interval)
+        wrapped_out = Out()
+        wrapped_state = self.wrapped_actor.on_start(id, wrapped_out)
+        state = LinkState(
+            next_send_seq=1,
+            msgs_pending_ack=(),
+            last_delivered_seqs=(),
+            wrapped_state=wrapped_state,
+        )
+        return self._process_output(state, wrapped_out, out)
+
+    def on_msg(self, id: Id, state: LinkState, src: Id, msg: Any, out: Out):
+        if isinstance(msg, DeliverMsg):
+            # Always ack to stop resends; drop if already delivered.
+            out.send(src, AckMsg(msg.seq))
+            if msg.seq <= state.delivered().get(src, 0):
+                return None
+
+            wrapped_out = Out()
+            returned = self.wrapped_actor.on_msg(
+                id, state.wrapped_state, src, msg.msg, wrapped_out
+            )
+            if is_no_op(returned, wrapped_out):
+                return None
+
+            delivered = state.delivered()
+            delivered[src] = msg.seq
+            next_state = LinkState(
+                next_send_seq=state.next_send_seq,
+                msgs_pending_ack=state.msgs_pending_ack,
+                last_delivered_seqs=_freeze(delivered),
+                wrapped_state=returned if returned is not None else state.wrapped_state,
+            )
+            return self._process_output(next_state, wrapped_out, out)
+
+        if isinstance(msg, AckMsg):
+            pending = state.pending()
+            pending.pop(msg.seq, None)
+            # The reference always clones here (ordered_reliable_link.rs:168);
+            # a redundant ack dedups against the parent by fingerprint.
+            return LinkState(
+                next_send_seq=state.next_send_seq,
+                msgs_pending_ack=_freeze(pending),
+                last_delivered_seqs=state.last_delivered_seqs,
+                wrapped_state=state.wrapped_state,
+            )
+
+        return None
+
+    def on_timeout(self, id: Id, state: LinkState, timer: Any, out: Out):
+        if isinstance(timer, NetworkTimer):
+            out.set_timer(NetworkTimer(), self.resend_interval)
+            for seq, (dst, msg) in sorted(state.msgs_pending_ack):
+                out.send(dst, DeliverMsg(seq, msg))
+            return None  # pruned as no-op-with-timer when nothing is pending
+
+        if isinstance(timer, UserTimer):
+            wrapped_out = Out()
+            returned = self.wrapped_actor.on_timeout(
+                id, state.wrapped_state, timer.timer, wrapped_out
+            )
+            if is_no_op(returned, wrapped_out):
+                return None
+            next_state = LinkState(
+                next_send_seq=state.next_send_seq,
+                msgs_pending_ack=state.msgs_pending_ack,
+                last_delivered_seqs=state.last_delivered_seqs,
+                wrapped_state=returned if returned is not None else state.wrapped_state,
+            )
+            return self._process_output(next_state, wrapped_out, out)
+
+        return None
+
+    def on_random(self, id: Id, state: LinkState, random: Any, out: Out):
+        wrapped_out = Out()
+        returned = self.wrapped_actor.on_random(
+            id, state.wrapped_state, random, wrapped_out
+        )
+        if is_no_op(returned, wrapped_out):
+            return None
+        next_state = LinkState(
+            next_send_seq=state.next_send_seq,
+            msgs_pending_ack=state.msgs_pending_ack,
+            last_delivered_seqs=state.last_delivered_seqs,
+            wrapped_state=returned if returned is not None else state.wrapped_state,
+        )
+        return self._process_output(next_state, wrapped_out, out)
+
+    # -- plumbing (ordered_reliable_link.rs:196-228) -------------------------
+
+    def _process_output(self, state: LinkState, wrapped_out: Out, out: Out) -> LinkState:
+        next_seq = state.next_send_seq
+        pending = state.pending()
+        for cmd in wrapped_out.commands:
+            if isinstance(cmd, Send):
+                out.send(cmd.dst, DeliverMsg(next_seq, cmd.msg))
+                pending[next_seq] = (cmd.dst, cmd.msg)
+                next_seq += 1
+            elif isinstance(cmd, SetTimer):
+                out.set_timer(UserTimer(cmd.timer), cmd.duration)
+            elif isinstance(cmd, CancelTimer):
+                out.cancel_timer(UserTimer(cmd.timer))
+            elif isinstance(cmd, ChooseRandom):
+                out.choose_random(cmd.key, cmd.choices)
+            else:
+                raise TypeError(f"unknown command: {cmd!r}")
+        return LinkState(
+            next_send_seq=next_seq,
+            msgs_pending_ack=_freeze(pending),
+            last_delivered_seqs=state.last_delivered_seqs,
+            wrapped_state=state.wrapped_state,
+        )

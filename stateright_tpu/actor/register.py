@@ -1,0 +1,149 @@
+"""Register actor kit: a reusable client + history hooks for consistency
+checking of register-like systems.
+
+Reference parity: src/actor/register.rs. `RegisterClient` performs
+`put_count` Puts (round-robin over the servers) followed by a Get; the
+`record_invocations` / `record_returns` hooks bridge the message protocol
+into a `ConsistencyTester` carried as the model's history variable.
+
+Unlike the reference, no `RegisterActor::Server` wrapper type is needed:
+Python actor lists are heterogeneous, so server actors are added to the
+model directly (their state types fingerprint distinctly by construction).
+Servers must still be added *before* clients — the client derives server
+ids as `(index + k) % server_count` (register.rs:117-119).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..semantics.register import Read as RegisterRead
+from ..semantics.register import ReadOk as RegisterReadOk
+from ..semantics.register import Write as RegisterWrite
+from ..semantics.register import WRITE_OK as REGISTER_WRITE_OK
+from .base import Actor, Out
+from .ids import Id
+from .network import Envelope
+
+
+# -- the wire protocol (register.rs:17-30) -----------------------------------
+
+@dataclass(frozen=True)
+class Internal:
+    """A message specific to the register system's internal protocol."""
+
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Put:
+    request_id: int
+    value: Any
+
+
+@dataclass(frozen=True)
+class Get:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class PutOk:
+    request_id: int
+
+
+@dataclass(frozen=True)
+class GetOk:
+    request_id: int
+    value: Any
+
+
+# -- history hooks (register.rs:33-91) ---------------------------------------
+
+def record_invocations(cfg, history, env: Envelope) -> Optional[Any]:
+    """Pass to `ActorModel.with_record_msg_out`: Get→Read, Put→Write."""
+    if isinstance(env.msg, Get):
+        history = history.copy()
+        history.on_invoke(env.src, RegisterRead())
+        return history
+    if isinstance(env.msg, Put):
+        history = history.copy()
+        history.on_invoke(env.src, RegisterWrite(env.msg.value))
+        return history
+    return None
+
+
+def record_returns(cfg, history, env: Envelope) -> Optional[Any]:
+    """Pass to `ActorModel.with_record_msg_in`: GetOk→ReadOk, PutOk→WriteOk."""
+    if isinstance(env.msg, GetOk):
+        history = history.copy()
+        history.on_return(env.dst, RegisterReadOk(env.msg.value))
+        return history
+    if isinstance(env.msg, PutOk):
+        history = history.copy()
+        history.on_return(env.dst, REGISTER_WRITE_OK)
+        return history
+    return None
+
+
+# -- the reusable client (register.rs:93-275) --------------------------------
+
+@dataclass(frozen=True)
+class RegisterClientState:
+    awaiting: Optional[int]
+    op_count: int
+
+
+class RegisterClient(Actor):
+    """Puts `put_count` values round-robin across servers, then Gets.
+
+    Request ids are `(op_count) * index`, values walk 'A'..+client-index for
+    the first put and 'Z'..-client-index for subsequent puts, exactly as the
+    reference does (register.rs:150-232) so histories stay comparable.
+    """
+
+    def __init__(self, put_count: int, server_count: int):
+        self.put_count = put_count
+        self.server_count = server_count
+
+    def name(self) -> str:
+        return "Client"
+
+    def on_start(self, id: Id, out: Out) -> RegisterClientState:
+        index = int(id)
+        if index < self.server_count:
+            raise ValueError(
+                "RegisterClient actors must be added to the model after servers."
+            )
+        if self.put_count == 0:
+            return RegisterClientState(awaiting=None, op_count=0)
+        unique_request_id = index  # next will be 2 * index
+        value = chr(ord("A") + index - self.server_count)
+        out.send(Id(index % self.server_count), Put(unique_request_id, value))
+        return RegisterClientState(awaiting=unique_request_id, op_count=1)
+
+    def on_msg(
+        self, id: Id, state: RegisterClientState, src: Id, msg: Any, out: Out
+    ) -> Optional[RegisterClientState]:
+        if state.awaiting is None:
+            return None
+        index = int(id)
+        if isinstance(msg, PutOk) and msg.request_id == state.awaiting:
+            unique_request_id = (state.op_count + 1) * index
+            if state.op_count < self.put_count:
+                value = chr(ord("Z") - (index - self.server_count))
+                out.send(
+                    Id((index + state.op_count) % self.server_count),
+                    Put(unique_request_id, value),
+                )
+            else:
+                out.send(
+                    Id((index + state.op_count) % self.server_count),
+                    Get(unique_request_id),
+                )
+            return RegisterClientState(
+                awaiting=unique_request_id, op_count=state.op_count + 1
+            )
+        if isinstance(msg, GetOk) and msg.request_id == state.awaiting:
+            return RegisterClientState(awaiting=None, op_count=state.op_count + 1)
+        return None

@@ -1,0 +1,256 @@
+"""Real-network execution: run the *same actor code* over UDP sockets.
+
+Reference parity: src/actor/spawn.rs — the framework's dual-execution
+property: model-check an actor system, then deploy it unchanged. Each actor
+runs an event loop bound to the UDP socket its `Id` encodes
+(Id ⇔ SocketAddrV4 bijection, ids.py): receive → deserialize → `on_msg`;
+timer/random interrupts are implemented by bounding the socket read timeout
+with the earliest pending deadline (spawn.rs:92-142). Serialization is
+pluggable; `json_serializer`/`json_deserializer` handle dataclass-based
+message types out of the box.
+
+Two engines run this event loop:
+
+  - the portable Python threading engine (`spawn`, default), and
+  - a native C++ event-loop core (`stateright_tpu.native`, used when built)
+    that owns the sockets, deadline heap, and poll loop, calling back into
+    the actor only for the protocol logic — the analogue of the reference
+    keeping its runtime in compiled code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .base import Actor, CancelTimer, ChooseRandom, Out, Send, SetTimer
+from .ids import Id, addr_from_id
+
+_PRACTICALLY_NEVER = float("inf")
+_RECV_BUF = 65_535  # matches the reference's receive buffer (spawn.rs:82)
+
+
+# ---------------------------------------------------------------------------
+# JSON serde for dataclass message protocols.
+# ---------------------------------------------------------------------------
+
+def json_serializer(msg: Any) -> bytes:
+    """Encode a message as JSON: dataclasses become ["TypeName", field...]."""
+    return json.dumps(_to_jsonable(msg)).encode("utf-8")
+
+
+def _to_jsonable(value: Any):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return [type(value).__name__] + [
+            _to_jsonable(getattr(value, f.name))
+            for f in dataclasses.fields(value)
+        ]
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+def make_json_deserializer(*message_types) -> Callable[[bytes], Any]:
+    """A deserializer recognizing ["TypeName", field...] for the given types."""
+    by_name = {t.__name__: t for t in message_types}
+
+    def deserialize(data: bytes) -> Any:
+        decoded = json.loads(data.decode("utf-8"))
+        return _from_jsonable(decoded, by_name)
+
+    return deserialize
+
+
+def _from_jsonable(value, by_name):
+    if isinstance(value, list) and value and isinstance(value[0], str) and value[0] in by_name:
+        cls = by_name[value[0]]
+        fields = [_from_jsonable(v, by_name) for v in value[1:]]
+        return cls(*fields)
+    if isinstance(value, list):
+        return [_from_jsonable(v, by_name) for v in value]
+    return value
+
+
+def json_deserializer(data: bytes) -> Any:
+    """Plain-JSON deserializer (no dataclass reconstruction)."""
+    return json.loads(data.decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# The event loop (one per actor).
+# ---------------------------------------------------------------------------
+
+class _ActorLoop:
+    def __init__(self, id: Id, actor: Actor, serialize, deserialize, stop: threading.Event):
+        self.id = Id(id)
+        self.actor = actor
+        self.serialize = serialize
+        self.deserialize = deserialize
+        self.stop = stop
+        # interrupt key -> absolute deadline; keys are ("t", timer) / ("r", random)
+        self.next_interrupts: Dict[Any, float] = {}
+        self.state: Any = None
+        ip, port = addr_from_id(self.id)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((ip, port))
+
+    def _on_command(self, cmd) -> None:
+        import random as _random
+
+        now = time.monotonic()
+        if isinstance(cmd, Send):
+            try:
+                payload = self.serialize(cmd.msg)
+            except Exception as e:  # unserializable: ignore (spawn.rs:178-186)
+                return
+            try:
+                self.sock.sendto(payload, addr_from_id(cmd.dst))
+            except OSError:
+                pass  # fire-and-forget (spawn.rs:188-196)
+        elif isinstance(cmd, SetTimer):
+            lo, hi = cmd.duration
+            duration = _random.uniform(lo, hi) if lo < hi else lo
+            self.next_interrupts[("t", cmd.timer)] = now + duration
+        elif isinstance(cmd, CancelTimer):
+            key = ("t", cmd.timer)
+            if key in self.next_interrupts:
+                self.next_interrupts[key] = _PRACTICALLY_NEVER
+        elif isinstance(cmd, ChooseRandom):
+            if not cmd.choices:
+                return
+            # The runtime resolves the nondeterminism the checker explored:
+            # pick one choice at a random future instant (spawn.rs:216-231).
+            chosen = _random.choice(list(cmd.choices))
+            self.next_interrupts[("r", chosen)] = now + _random.uniform(0.0, 10.0)
+
+    def _dispatch(self, out: Out) -> None:
+        for cmd in out.commands:
+            self._on_command(cmd)
+
+    def run(self) -> None:
+        out = Out()
+        self.state = self.actor.on_start(self.id, out)
+        self._dispatch(out)
+
+        while not self.stop.is_set():
+            out = Out()
+            if self.next_interrupts:
+                min_key = min(self.next_interrupts, key=self.next_interrupts.get)
+                min_deadline = self.next_interrupts[min_key]
+            else:
+                min_key, min_deadline = None, _PRACTICALLY_NEVER
+            max_wait = min_deadline - time.monotonic()
+
+            if max_wait > 0:
+                self.sock.settimeout(min(max_wait, 0.25))  # 0.25s stop poll
+                try:
+                    data, src_addr = self.sock.recvfrom(_RECV_BUF)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    continue
+                try:
+                    msg = self.deserialize(data)
+                except Exception:
+                    continue  # unparseable: ignore (spawn.rs:123-127)
+                src = Id.from_addr(*src_addr)
+                returned = self.actor.on_msg(self.id, self.state, src, msg, out)
+            else:
+                del self.next_interrupts[min_key]  # interrupt consumed
+                kind, payload = min_key
+                if kind == "t":
+                    returned = self.actor.on_timeout(self.id, self.state, payload, out)
+                else:
+                    returned = self.actor.on_random(self.id, self.state, payload, out)
+
+            if returned is not None:
+                self.state = returned
+            self._dispatch(out)
+
+        self.sock.close()
+
+
+def spawn(
+    serialize: Callable[[Any], bytes],
+    deserialize: Callable[[bytes], Any],
+    actors: List[Tuple[Any, Actor]],
+    background: bool = False,
+    engine: str = "auto",
+) -> "SpawnHandle":
+    """Run each actor on its own thread with a UDP socket.
+
+    Reference: `spawn()` (spawn.rs:64-154). `actors` pairs ids (or
+    (ip, port) tuples) with actor instances. Blocks forever unless
+    `background=True`, in which case a `SpawnHandle` controls shutdown —
+    a capability the reference lacks, added for testability.
+
+    `engine="native"` requires the C++ runtime extension; `"auto"` uses it
+    when available, falling back to Python threads.
+    """
+    resolved: List[Tuple[Id, Actor]] = []
+    for id_or_addr, actor in actors:
+        if isinstance(id_or_addr, tuple):
+            resolved.append((Id.from_addr(*id_or_addr), actor))
+        else:
+            resolved.append((Id(id_or_addr), actor))
+
+    if engine in ("auto", "native"):
+        native = _native_runtime()
+        if native is not None:
+            return native.spawn(serialize, deserialize, resolved, background)
+        if engine == "native":
+            raise RuntimeError(
+                "native spawn engine requested but the C++ runtime extension "
+                "is not built (run: python -m stateright_tpu.native.build)"
+            )
+
+    stop = threading.Event()
+    loops = [_ActorLoop(id, actor, serialize, deserialize, stop) for id, actor in resolved]
+    threads = [
+        threading.Thread(target=loop.run, name=f"actor-{int(loop.id)}", daemon=True)
+        for loop in loops
+    ]
+    for t in threads:
+        t.start()
+    handle = SpawnHandle(stop, threads, loops)
+    if not background:
+        try:
+            while any(t.is_alive() for t in threads):
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            handle.shutdown()
+    return handle
+
+
+def _native_runtime():
+    try:
+        module = importlib.import_module("stateright_tpu.native.runtime")
+    except Exception:
+        return None
+    return module if getattr(module, "is_available", lambda: False)() else None
+
+
+class SpawnHandle:
+    """Controls a running actor deployment (background mode)."""
+
+    def __init__(self, stop: threading.Event, threads, loops):
+        self._stop = stop
+        self._threads = threads
+        self._loops = loops
+
+    def state(self, id) -> Any:
+        """Peek at an actor's current state (for tests/debugging)."""
+        for loop in self._loops:
+            if loop.id == Id(id):
+                return loop.state
+        raise KeyError(f"no actor with id {id!r}")
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)

@@ -1,0 +1,177 @@
+"""Write-once-register actor kit: like the register kit plus `PutFail`.
+
+Reference parity: src/actor/write_once_register.rs. The message protocol
+adds `PutFail` (a rejected write), `record_returns` maps it to
+`WriteFail`, and the client treats it like `PutOk` for sequencing purposes
+(write_once_register.rs:247-266). Message `rewrite_with` hooks keep the
+protocol symmetric under id permutation (write_once_register.rs:300-332) —
+request ids and values pass through, only embedded internal messages are
+rewritten.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..semantics.write_once_register import Read as WORead
+from ..semantics.write_once_register import ReadOk as WOReadOk
+from ..semantics.write_once_register import Write as WOWrite
+from ..semantics.write_once_register import WRITE_FAIL as WO_WRITE_FAIL
+from ..semantics.write_once_register import WRITE_OK as WO_WRITE_OK
+from .base import Actor, Out
+from .ids import Id
+from .network import Envelope
+
+
+# -- the wire protocol (write_once_register.rs:16-31) ------------------------
+
+@dataclass(frozen=True)
+class Internal:
+    msg: Any
+
+    def rewrite_with(self, plan):
+        return Internal(plan.rewrite(self.msg))
+
+
+@dataclass(frozen=True)
+class Put:
+    request_id: int
+    value: Any
+
+    def rewrite_with(self, plan):
+        return self  # request ids and values carry no actor ids
+
+
+@dataclass(frozen=True)
+class Get:
+    request_id: int
+
+    def rewrite_with(self, plan):
+        return self
+
+
+@dataclass(frozen=True)
+class PutOk:
+    request_id: int
+
+    def rewrite_with(self, plan):
+        return self
+
+
+@dataclass(frozen=True)
+class PutFail:
+    request_id: int
+
+    def rewrite_with(self, plan):
+        return self
+
+
+@dataclass(frozen=True)
+class GetOk:
+    request_id: int
+    value: Any
+
+    def rewrite_with(self, plan):
+        return self
+
+
+# -- history hooks (write_once_register.rs:34-97) ----------------------------
+
+def record_invocations(cfg, history, env: Envelope) -> Optional[Any]:
+    """Pass to `ActorModel.with_record_msg_out`: Get→Read, Put→Write."""
+    if isinstance(env.msg, Get):
+        history = history.copy()
+        history.on_invoke(env.src, WORead())
+        return history
+    if isinstance(env.msg, Put):
+        history = history.copy()
+        history.on_invoke(env.src, WOWrite(env.msg.value))
+        return history
+    return None
+
+
+def record_returns(cfg, history, env: Envelope) -> Optional[Any]:
+    """Pass to `ActorModel.with_record_msg_in`: GetOk→ReadOk, PutOk→WriteOk,
+    PutFail→WriteFail."""
+    if isinstance(env.msg, GetOk):
+        history = history.copy()
+        history.on_return(env.dst, WOReadOk(env.msg.value))
+        return history
+    if isinstance(env.msg, PutOk):
+        history = history.copy()
+        history.on_return(env.dst, WO_WRITE_OK)
+        return history
+    if isinstance(env.msg, PutFail):
+        history = history.copy()
+        history.on_return(env.dst, WO_WRITE_FAIL)
+        return history
+    return None
+
+
+# -- the reusable client (write_once_register.rs:100-298) --------------------
+
+@dataclass(frozen=True)
+class WORegisterClientState:
+    awaiting: Optional[int]
+    op_count: int
+
+    def rewrite_with(self, plan):
+        return self
+
+
+class WORegisterClient(Actor):
+    """Puts `put_count` values round-robin across servers, then Gets.
+
+    `PutFail` advances the sequence just like `PutOk`
+    (write_once_register.rs:247-266).
+    """
+
+    def __init__(self, put_count: int, server_count: int):
+        self.put_count = put_count
+        self.server_count = server_count
+
+    def name(self) -> str:
+        return "Client"
+
+    def on_start(self, id: Id, out: Out) -> WORegisterClientState:
+        index = int(id)
+        if index < self.server_count:
+            raise ValueError(
+                "WORegisterClient actors must be added to the model after servers."
+            )
+        if self.put_count == 0:
+            return WORegisterClientState(awaiting=None, op_count=0)
+        unique_request_id = index  # next will be 2 * index
+        value = chr(ord("A") + index - self.server_count)
+        out.send(Id(index % self.server_count), Put(unique_request_id, value))
+        return WORegisterClientState(awaiting=unique_request_id, op_count=1)
+
+    def on_msg(
+        self, id: Id, state: WORegisterClientState, src: Id, msg: Any, out: Out
+    ) -> Optional[WORegisterClientState]:
+        if state.awaiting is None:
+            return None
+        index = int(id)
+        if (
+            isinstance(msg, (PutOk, PutFail))
+            and msg.request_id == state.awaiting
+        ):
+            unique_request_id = (state.op_count + 1) * index
+            if state.op_count < self.put_count:
+                value = chr(ord("Z") - (index - self.server_count))
+                out.send(
+                    Id((index + state.op_count) % self.server_count),
+                    Put(unique_request_id, value),
+                )
+            else:
+                out.send(
+                    Id((index + state.op_count) % self.server_count),
+                    Get(unique_request_id),
+                )
+            return WORegisterClientState(
+                awaiting=unique_request_id, op_count=state.op_count + 1
+            )
+        if isinstance(msg, GetOk) and msg.request_id == state.awaiting:
+            return WORegisterClientState(awaiting=None, op_count=state.op_count + 1)
+        return None

@@ -9,11 +9,14 @@ integration-test and benchmark suite, with golden unique-state counts.
 from .fixtures import BinaryClock, DGraph, LinearEquation, Panicker
 from .two_phase_commit import TwoPhaseSys, TwoPhaseTensor
 from .increment import Increment, IncrementTensor
+from .increment_lock import IncrementLock, IncrementLockTensor
 
 __all__ = [
     "BinaryClock",
     "DGraph",
     "Increment",
+    "IncrementLock",
+    "IncrementLockTensor",
     "IncrementTensor",
     "LinearEquation",
     "Panicker",

@@ -1,0 +1,163 @@
+"""Lock-protected shared-memory counter: the race in `increment` fixed.
+
+Reference: examples/increment_lock.rs — each thread Lock→Read→Write→Release;
+the "fin" invariant now holds, and a "mutex" invariant asserts at most one
+thread is inside the critical section.
+
+`IncrementLock` is the host model; `IncrementLockTensor` the dense TPU
+encoding (lane 0 = counter, lane 1 = lock bit, lanes 2+2k/3+2k = thread k's
+local value and program counter; action slots 4k..4k+3 = Lock/Read/Write/
+Release for thread k).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ..core import Model, Property
+from ..tensor import TensorModel, TensorProperty
+
+
+@dataclass(frozen=True)
+class IncrementLockState:
+    i: int
+    lock: bool
+    s: Tuple[Tuple[int, int], ...]  # per-thread (t, pc)
+
+    def representative(self) -> "IncrementLockState":
+        """Sort the identical threads (examples/increment_lock.rs:35-45)."""
+        return IncrementLockState(self.i, self.lock, tuple(sorted(self.s)))
+
+
+class IncrementLock(Model):
+    """Host model. Reference: examples/increment_lock.rs:47-107."""
+
+    def __init__(self, thread_count: int):
+        self.n = thread_count
+
+    def init_states(self) -> List[IncrementLockState]:
+        return [IncrementLockState(0, False, ((0, 0),) * self.n)]
+
+    def actions(self, state: IncrementLockState, actions: List) -> None:
+        for tid in range(self.n):
+            pc = state.s[tid][1]
+            if pc == 0 and not state.lock:
+                actions.append(("Lock", tid))
+            elif pc == 1:
+                actions.append(("Read", tid))
+            elif pc == 2:
+                actions.append(("Write", tid))
+            elif pc == 3 and state.lock:
+                actions.append(("Release", tid))
+
+    def next_state(self, state: IncrementLockState, action) -> IncrementLockState:
+        kind, tid = action
+        s = list(state.s)
+        t, _pc = state.s[tid]
+        if kind == "Lock":
+            s[tid] = (t, 1)
+            return IncrementLockState(state.i, True, tuple(s))
+        if kind == "Read":
+            s[tid] = (state.i, 2)
+            return IncrementLockState(state.i, state.lock, tuple(s))
+        if kind == "Write":
+            s[tid] = (t, 3)
+            return IncrementLockState((t + 1) % 256, state.lock, tuple(s))
+        s[tid] = (t, 4)  # Release
+        return IncrementLockState(state.i, False, tuple(s))
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.always(
+                "fin",
+                lambda _m, s: sum(1 for (_t, pc) in s.s if pc >= 3) % 256 == s.i,
+            ),
+            Property.always(
+                "mutex",
+                lambda _m, s: sum(1 for (_t, pc) in s.s if 1 <= pc < 4) <= 1,
+            ),
+        ]
+
+
+class IncrementLockTensor(TensorModel):
+    """Dense encoding of `IncrementLock` for the batched TPU engine."""
+
+    def __init__(self, thread_count: int):
+        self.n = thread_count
+        self.state_width = 2 + 2 * thread_count
+        self.max_actions = 4 * thread_count
+
+    def init_states_array(self) -> np.ndarray:
+        return np.zeros((1, self.state_width), dtype=np.uint32)
+
+    def step_batch(self, xp, states):
+        u = xp.uint32
+        succs = []
+        masks = []
+        shared = states[:, 0]
+        lock = states[:, 1]
+        for k in range(self.n):
+            t = states[:, 2 + 2 * k]
+            pc = states[:, 3 + 2 * k]
+
+            # Lock(k): lock <- 1, pc <- 1 (enabled iff pc == 0 and !lock)
+            cols = [states[:, j] for j in range(self.state_width)]
+            cols[1] = xp.ones_like(lock)
+            cols[3 + 2 * k] = xp.full_like(pc, 1)
+            succs.append(xp.stack(cols, axis=-1))
+            masks.append((pc == u(0)) & (lock == u(0)))
+
+            # Read(k): t <- shared, pc <- 2
+            cols = [states[:, j] for j in range(self.state_width)]
+            cols[2 + 2 * k] = shared
+            cols[3 + 2 * k] = xp.full_like(pc, 2)
+            succs.append(xp.stack(cols, axis=-1))
+            masks.append(pc == u(1))
+
+            # Write(k): shared <- t + 1, pc <- 3
+            cols = [states[:, j] for j in range(self.state_width)]
+            cols[0] = (t + u(1)) & u(0xFF)
+            cols[3 + 2 * k] = xp.full_like(pc, 3)
+            succs.append(xp.stack(cols, axis=-1))
+            masks.append(pc == u(2))
+
+            # Release(k): lock <- 0, pc <- 4
+            cols = [states[:, j] for j in range(self.state_width)]
+            cols[1] = xp.zeros_like(lock)
+            cols[3 + 2 * k] = xp.full_like(pc, 4)
+            succs.append(xp.stack(cols, axis=-1))
+            masks.append((pc == u(3)) & (lock == u(1)))
+
+        return xp.stack(succs, axis=1), xp.stack(masks, axis=1)
+
+    def tensor_properties(self) -> List[TensorProperty]:
+        n = self.n
+
+        def fin(xp, states):
+            count = xp.zeros(states.shape[0], dtype=xp.uint32)
+            for k in range(n):
+                count = count + (states[:, 3 + 2 * k] >= xp.uint32(3)).astype(
+                    xp.uint32
+                )
+            return (count & xp.uint32(0xFF)) == states[:, 0]
+
+        def mutex(xp, states):
+            count = xp.zeros(states.shape[0], dtype=xp.uint32)
+            for k in range(n):
+                pc = states[:, 3 + 2 * k]
+                count = count + (
+                    (pc >= xp.uint32(1)) & (pc < xp.uint32(4))
+                ).astype(xp.uint32)
+            return count <= xp.uint32(1)
+
+        return [
+            TensorProperty.always("fin", fin),
+            TensorProperty.always("mutex", mutex),
+        ]
+
+    def format_action(self, a: int) -> str:
+        tid, kind = divmod(a, 4)
+        return f"{('Lock', 'Read', 'Write', 'Release')[kind]}({tid})"

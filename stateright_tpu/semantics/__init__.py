@@ -1,0 +1,31 @@
+"""Consistency semantics: correctness via sequential reference objects.
+
+Reference parity: src/semantics.rs. `SequentialSpec` defines correctness by
+a reference implementation ("this system should behave like a register");
+`ConsistencyTester` implementations record potentially-concurrent operation
+histories and decide whether they admit a valid serialization:
+
+  - `LinearizabilityTester`   — total order must respect real-time
+    (happens-before) precedence across threads;
+  - `SequentialConsistencyTester` — per-thread order only.
+
+A tester is typically carried as an `ActorModel` history variable and
+interrogated from an `always` property; it is a hashable value object so
+it participates in state fingerprints.
+"""
+
+from .consistency_tester import ConsistencyTester
+from .linearizability import LinearizabilityTester
+from .sequential_consistency import SequentialConsistencyTester
+from .spec import SequentialSpec
+from . import register, vec, write_once_register
+
+__all__ = [
+    "ConsistencyTester",
+    "LinearizabilityTester",
+    "SequentialConsistencyTester",
+    "SequentialSpec",
+    "register",
+    "vec",
+    "write_once_register",
+]

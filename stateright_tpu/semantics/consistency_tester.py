@@ -1,0 +1,30 @@
+"""ConsistencyTester: the history-recording interface.
+
+Reference: src/semantics/consistency_tester.rs. Recording methods return
+`self` for chaining. A recording error (double-invoke, return without
+invoke) *poisons* the tester — the history becomes permanently invalid
+(`is_consistent()` is False) and `last_error` holds the diagnostic —
+mirroring the reference's `Err(...)` + `is_valid_history = false` behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class ConsistencyTester:
+    def on_invoke(self, thread_id: Any, op: Any) -> "ConsistencyTester":
+        """Record that `thread_id` invoked `op`."""
+        raise NotImplementedError
+
+    def on_return(self, thread_id: Any, ret: Any) -> "ConsistencyTester":
+        """Record that `thread_id`'s earlier invocation returned `ret`."""
+        raise NotImplementedError
+
+    def is_consistent(self) -> bool:
+        """Whether the recorded history admits a valid serialization."""
+        raise NotImplementedError
+
+    def on_invret(self, thread_id: Any, op: Any, ret: Any) -> "ConsistencyTester":
+        """Record an operation and its return together (consistency_tester.rs:32-43)."""
+        return self.on_invoke(thread_id, op).on_return(thread_id, ret)

@@ -1,0 +1,209 @@
+"""LinearizabilityTester: real-time-respecting serialization search.
+
+Reference: src/semantics/linearizability.rs. On each invocation the tester
+records, for every *other* thread, the index of that thread's last completed
+operation. During serialization an operation may only be placed once every
+peer has consumed its history up to that recorded index — this is what
+enforces the happens-before ("real time") order that distinguishes
+linearizability from sequential consistency.
+
+The serialization itself is an exponential backtracking interleaving search
+(linearizability.rs:193-280): keep histories tiny (the reference's register
+examples default to one put per client for exactly this reason).
+
+The tester is a hashable value object so it can serve as an `ActorModel`
+history variable; recording hooks must call `.copy()` first (histories are
+shared between system states).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .consistency_tester import ConsistencyTester
+from .spec import SequentialSpec
+
+# Per-thread history entry: (last-completed-index-by-peer, op, ret).
+# In-flight entry: (last-completed-index-by-peer, op).
+
+
+class LinearizabilityTester(ConsistencyTester):
+    __slots__ = (
+        "init_ref_obj",
+        "history_by_thread",
+        "in_flight_by_thread",
+        "is_valid_history",
+        "last_error",
+    )
+
+    def __init__(self, init_ref_obj: SequentialSpec):
+        self.init_ref_obj = init_ref_obj
+        self.history_by_thread: Dict[Any, List[Tuple[dict, Any, Any]]] = {}
+        self.in_flight_by_thread: Dict[Any, Tuple[dict, Any]] = {}
+        self.is_valid_history = True
+        self.last_error: Optional[str] = None
+
+    def copy(self) -> "LinearizabilityTester":
+        new = LinearizabilityTester.__new__(LinearizabilityTester)
+        new.init_ref_obj = self.init_ref_obj.copy()
+        new.history_by_thread = {t: list(h) for t, h in self.history_by_thread.items()}
+        new.in_flight_by_thread = dict(self.in_flight_by_thread)
+        new.is_valid_history = self.is_valid_history
+        new.last_error = self.last_error
+        return new
+
+    def __len__(self) -> int:
+        """Operations completed or in flight, across all threads."""
+        return len(self.in_flight_by_thread) + sum(
+            len(h) for h in self.history_by_thread.values()
+        )
+
+    def _poison(self, message: str) -> "LinearizabilityTester":
+        self.is_valid_history = False
+        self.last_error = message
+        return self
+
+    # -- recording (linearizability.rs:100-166) -----------------------------
+
+    def on_invoke(self, thread_id: Any, op: Any) -> "LinearizabilityTester":
+        if not self.is_valid_history:
+            return self
+        if thread_id in self.in_flight_by_thread:
+            _, pending = self.in_flight_by_thread[thread_id]
+            return self._poison(
+                f"Thread already has an operation in flight. "
+                f"thread_id={thread_id!r}, op={pending!r}"
+            )
+        last_completed = {
+            t: len(h) - 1
+            for t, h in self.history_by_thread.items()
+            if t != thread_id and h
+        }
+        self.in_flight_by_thread[thread_id] = (last_completed, op)
+        self.history_by_thread.setdefault(thread_id, [])
+        return self
+
+    def on_return(self, thread_id: Any, ret: Any) -> "LinearizabilityTester":
+        if not self.is_valid_history:
+            return self
+        entry = self.in_flight_by_thread.pop(thread_id, None)
+        if entry is None:
+            return self._poison(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}"
+            )
+        completed, op = entry
+        self.history_by_thread.setdefault(thread_id, []).append((completed, op, ret))
+        return self
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    # -- serialization (linearizability.rs:175-280) -------------------------
+
+    def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        """A valid total order of the recorded history, or None."""
+        if not self.is_valid_history:
+            return None
+        remaining = {
+            t: tuple(enumerate(h)) for t, h in self.history_by_thread.items()
+        }
+        return _serialize(
+            [], self.init_ref_obj, remaining, dict(self.in_flight_by_thread)
+        )
+
+    # -- value-object protocol ----------------------------------------------
+
+    def __hash__(self) -> int:
+        from ..fingerprint import fingerprint
+
+        return fingerprint(self)
+
+    def fingerprint_key(self):
+        return (
+            self.init_ref_obj,
+            {
+                t: tuple((tuple(sorted(c.items())), op, ret) for c, op, ret in h)
+                for t, h in self.history_by_thread.items()
+            },
+            {
+                t: (tuple(sorted(c.items())), op)
+                for t, (c, op) in self.in_flight_by_thread.items()
+            },
+            self.is_valid_history,
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, LinearizabilityTester)
+            and self.init_ref_obj == other.init_ref_obj
+            and self.history_by_thread == other.history_by_thread
+            and self.in_flight_by_thread == other.in_flight_by_thread
+            and self.is_valid_history == other.is_valid_history
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"LinearizabilityTester(init={self.init_ref_obj!r}, "
+            f"history={self.history_by_thread!r}, "
+            f"in_flight={self.in_flight_by_thread!r}, "
+            f"valid={self.is_valid_history})"
+        )
+
+
+def _violates_real_time(completed: dict, remaining: dict) -> bool:
+    """An op invoked after peer ops completed cannot precede them.
+
+    `completed[peer] = i` means peer's ops 0..=i finished before this op
+    began; if peer still has entry i (or earlier) unconsumed, placing this
+    op now would reorder real time (linearizability.rs:224-237).
+    """
+    for peer_id, min_peer_time in completed.items():
+        peer_ops = remaining.get(peer_id)
+        if peer_ops and peer_ops[0][0] <= min_peer_time:
+            return True
+    return False
+
+
+def _serialize(
+    valid_history: list,
+    ref_obj: SequentialSpec,
+    remaining: Dict[Any, tuple],
+    in_flight: Dict[Any, Tuple[dict, Any]],
+) -> Optional[List[Tuple[Any, Any]]]:
+    if all(not h for h in remaining.values()):
+        return valid_history
+
+    for thread_id in sorted(remaining):
+        history = remaining[thread_id]
+        if not history:
+            # Case 1: nothing completed left; maybe an in-flight op can be
+            # placed here (its return never arrived, but it may have taken
+            # effect).
+            entry = in_flight.get(thread_id)
+            if entry is None:
+                continue
+            completed, op = entry
+            if _violates_real_time(completed, remaining):
+                continue
+            obj = ref_obj.copy()
+            ret = obj.invoke(op)
+            next_valid = valid_history + [(op, ret)]
+            next_remaining = remaining
+            next_in_flight = {t: e for t, e in in_flight.items() if t != thread_id}
+        else:
+            # Case 2: try this thread's next completed op.
+            _, (completed, op, ret) = history[0]
+            next_remaining = dict(remaining)
+            next_remaining[thread_id] = history[1:]
+            if _violates_real_time(completed, next_remaining):
+                continue
+            obj = ref_obj.copy()
+            if not obj.is_valid_step(op, ret):
+                continue
+            next_valid = valid_history + [(op, ret)]
+            next_in_flight = in_flight
+        result = _serialize(next_valid, obj, next_remaining, next_in_flight)
+        if result is not None:
+            return result
+    return None

@@ -1,0 +1,74 @@
+"""Register operational semantics. Reference: src/semantics/register.rs."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from .spec import SequentialSpec
+
+
+@dataclass(frozen=True)
+class Write:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Read:
+    pass
+
+
+@dataclass(frozen=True)
+class WriteOk:
+    pass
+
+
+@dataclass(frozen=True)
+class ReadOk:
+    value: Any
+
+
+READ = Read()
+WRITE_OK = WriteOk()
+
+
+class Register(SequentialSpec):
+    """A read/write register. Reference: register.rs:8-49."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+    def copy(self) -> "Register":
+        return Register(self.value)
+
+    def invoke(self, op: Any) -> Any:
+        if isinstance(op, Write):
+            self.value = op.value
+            return WRITE_OK
+        if isinstance(op, Read):
+            return ReadOk(self.value)
+        raise TypeError(f"not a register op: {op!r}")
+
+    def is_valid_step(self, op: Any, ret: Any) -> bool:
+        if isinstance(op, Write) and isinstance(ret, WriteOk):
+            self.value = op.value
+            return True
+        if isinstance(op, Read) and isinstance(ret, ReadOk):
+            return self.value == ret.value
+        return False
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Register) and self.value == other.value
+
+    def __repr__(self) -> str:
+        return f"Register({self.value!r})"
+
+    def __hash__(self) -> int:
+        from ..fingerprint import fingerprint
+
+        return fingerprint(self)
+
+    def fingerprint_key(self):
+        return self.value

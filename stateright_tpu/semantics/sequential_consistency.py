@@ -1,0 +1,155 @@
+"""SequentialConsistencyTester: per-thread-order-only serialization search.
+
+Reference: src/semantics/sequential_consistency.rs. Identical in shape to
+the linearizability tester minus the real-time precedence bookkeeping:
+any interleaving preserving each thread's own order is acceptable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .consistency_tester import ConsistencyTester
+from .spec import SequentialSpec
+
+
+class SequentialConsistencyTester(ConsistencyTester):
+    __slots__ = (
+        "init_ref_obj",
+        "history_by_thread",
+        "in_flight_by_thread",
+        "is_valid_history",
+        "last_error",
+    )
+
+    def __init__(self, init_ref_obj: SequentialSpec):
+        self.init_ref_obj = init_ref_obj
+        self.history_by_thread: Dict[Any, List[Tuple[Any, Any]]] = {}
+        self.in_flight_by_thread: Dict[Any, Any] = {}
+        self.is_valid_history = True
+        self.last_error: Optional[str] = None
+
+    def copy(self) -> "SequentialConsistencyTester":
+        new = SequentialConsistencyTester.__new__(SequentialConsistencyTester)
+        new.init_ref_obj = self.init_ref_obj.copy()
+        new.history_by_thread = {t: list(h) for t, h in self.history_by_thread.items()}
+        new.in_flight_by_thread = dict(self.in_flight_by_thread)
+        new.is_valid_history = self.is_valid_history
+        new.last_error = self.last_error
+        return new
+
+    def __len__(self) -> int:
+        return len(self.in_flight_by_thread) + sum(
+            len(h) for h in self.history_by_thread.values()
+        )
+
+    def _poison(self, message: str) -> "SequentialConsistencyTester":
+        self.is_valid_history = False
+        self.last_error = message
+        return self
+
+    # -- recording (sequential_consistency.rs:95-143) -----------------------
+
+    def on_invoke(self, thread_id: Any, op: Any) -> "SequentialConsistencyTester":
+        if not self.is_valid_history:
+            return self
+        if thread_id in self.in_flight_by_thread:
+            return self._poison(
+                f"Thread already has an operation in flight. "
+                f"thread_id={thread_id!r}, op={self.in_flight_by_thread[thread_id]!r}"
+            )
+        self.in_flight_by_thread[thread_id] = op
+        self.history_by_thread.setdefault(thread_id, [])
+        return self
+
+    def on_return(self, thread_id: Any, ret: Any) -> "SequentialConsistencyTester":
+        if not self.is_valid_history:
+            return self
+        if thread_id not in self.in_flight_by_thread:
+            return self._poison(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}"
+            )
+        op = self.in_flight_by_thread.pop(thread_id)
+        self.history_by_thread.setdefault(thread_id, []).append((op, ret))
+        return self
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    # -- serialization (sequential_consistency.rs:148-~260) ------------------
+
+    def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        if not self.is_valid_history:
+            return None
+        remaining = {t: tuple(h) for t, h in self.history_by_thread.items()}
+        return _serialize(
+            [], self.init_ref_obj, remaining, dict(self.in_flight_by_thread)
+        )
+
+    # -- value-object protocol ----------------------------------------------
+
+    def __hash__(self) -> int:
+        from ..fingerprint import fingerprint
+
+        return fingerprint(self)
+
+    def fingerprint_key(self):
+        return (
+            self.init_ref_obj,
+            {t: tuple(h) for t, h in self.history_by_thread.items()},
+            self.in_flight_by_thread,
+            self.is_valid_history,
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, SequentialConsistencyTester)
+            and self.init_ref_obj == other.init_ref_obj
+            and self.history_by_thread == other.history_by_thread
+            and self.in_flight_by_thread == other.in_flight_by_thread
+            and self.is_valid_history == other.is_valid_history
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"SequentialConsistencyTester(init={self.init_ref_obj!r}, "
+            f"history={self.history_by_thread!r}, "
+            f"in_flight={self.in_flight_by_thread!r}, "
+            f"valid={self.is_valid_history})"
+        )
+
+
+def _serialize(
+    valid_history: list,
+    ref_obj: SequentialSpec,
+    remaining: Dict[Any, tuple],
+    in_flight: Dict[Any, Any],
+) -> Optional[List[Tuple[Any, Any]]]:
+    if all(not h for h in remaining.values()):
+        return valid_history
+
+    for thread_id in sorted(remaining):
+        history = remaining[thread_id]
+        if not history:
+            op = in_flight.get(thread_id)
+            if op is None:
+                continue
+            obj = ref_obj.copy()
+            ret = obj.invoke(op)
+            next_valid = valid_history + [(op, ret)]
+            next_remaining = remaining
+            next_in_flight = {t: o for t, o in in_flight.items() if t != thread_id}
+        else:
+            op, ret = history[0]
+            obj = ref_obj.copy()
+            if not obj.is_valid_step(op, ret):
+                continue
+            next_valid = valid_history + [(op, ret)]
+            next_remaining = dict(remaining)
+            next_remaining[thread_id] = history[1:]
+            next_in_flight = in_flight
+        result = _serialize(next_valid, obj, next_remaining, next_in_flight)
+        if result is not None:
+            return result
+    return None

@@ -1,0 +1,31 @@
+"""SequentialSpec: a sequential "reference object" defining correctness.
+
+Reference: the `SequentialSpec` trait (src/semantics.rs:73-98). Implement
+`invoke` (mutating the object, returning the op's return value) and `copy`;
+`is_valid_step` / `is_valid_history` have default implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Tuple
+
+
+class SequentialSpec:
+    def invoke(self, op: Any) -> Any:
+        """Apply `op` to this object, returning the operation's value."""
+        raise NotImplementedError
+
+    def copy(self) -> "SequentialSpec":
+        """An independent copy (testers branch the object during search)."""
+        raise NotImplementedError
+
+    def is_valid_step(self, op: Any, ret: Any) -> bool:
+        """Whether invoking `op` may return `ret` (mutates on success path).
+
+        Reference: semantics.rs:85-90.
+        """
+        return self.invoke(op) == ret
+
+    def is_valid_history(self, ops: Iterable[Tuple[Any, Any]]) -> bool:
+        """Whether a sequential (op, ret) history is valid for this object."""
+        return all(self.is_valid_step(op, ret) for op, ret in ops)

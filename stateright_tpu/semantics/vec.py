@@ -1,0 +1,91 @@
+"""Stack (Vec) operational semantics. Reference: src/semantics/vec.rs."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .spec import SequentialSpec
+
+
+@dataclass(frozen=True)
+class Push:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Pop:
+    pass
+
+
+@dataclass(frozen=True)
+class Len:
+    pass
+
+
+@dataclass(frozen=True)
+class PushOk:
+    pass
+
+
+@dataclass(frozen=True)
+class PopOk:
+    value: Any  # None when the stack was empty
+
+
+@dataclass(frozen=True)
+class LenOk:
+    length: int
+
+
+POP = Pop()
+LEN = Len()
+PUSH_OK = PushOk()
+
+
+class VecSpec(SequentialSpec):
+    """A stack, the Python analogue of the reference's `impl SequentialSpec
+    for Vec<T>` (vec.rs:22-50)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items=()):
+        self.items = list(items)
+
+    def copy(self) -> "VecSpec":
+        return VecSpec(self.items)
+
+    def invoke(self, op: Any) -> Any:
+        if isinstance(op, Push):
+            self.items.append(op.value)
+            return PUSH_OK
+        if isinstance(op, Pop):
+            return PopOk(self.items.pop() if self.items else None)
+        if isinstance(op, Len):
+            return LenOk(len(self.items))
+        raise TypeError(f"not a vec op: {op!r}")
+
+    def is_valid_step(self, op: Any, ret: Any) -> bool:
+        if isinstance(op, Push) and isinstance(ret, PushOk):
+            self.items.append(op.value)
+            return True
+        if isinstance(op, Pop) and isinstance(ret, PopOk):
+            popped = self.items.pop() if self.items else None
+            return popped == ret.value
+        if isinstance(op, Len) and isinstance(ret, LenOk):
+            return len(self.items) == ret.length
+        return False
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, VecSpec) and self.items == other.items
+
+    def __repr__(self) -> str:
+        return f"VecSpec({self.items!r})"
+
+    def __hash__(self) -> int:
+        from ..fingerprint import fingerprint
+
+        return fingerprint(self)
+
+    def fingerprint_key(self):
+        return tuple(self.items)

@@ -1,0 +1,93 @@
+"""Write-once register operational semantics.
+
+Reference: src/semantics/write_once_register.rs. A write succeeds while the
+register is unset (or when re-writing the identical value); later differing
+writes fail; reads return the current optional value.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .spec import SequentialSpec
+
+
+@dataclass(frozen=True)
+class Write:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Read:
+    pass
+
+
+@dataclass(frozen=True)
+class WriteOk:
+    pass
+
+
+@dataclass(frozen=True)
+class WriteFail:
+    pass
+
+
+@dataclass(frozen=True)
+class ReadOk:
+    value: Any  # None when the register is unset
+
+
+READ = Read()
+WRITE_OK = WriteOk()
+WRITE_FAIL = WriteFail()
+
+
+class WORegister(SequentialSpec):
+    """Reference: write_once_register.rs:8-58."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Optional[Any] = None):
+        self.value = value
+
+    def copy(self) -> "WORegister":
+        return WORegister(self.value)
+
+    def invoke(self, op: Any) -> Any:
+        if isinstance(op, Write):
+            if self.value is None or self.value == op.value:
+                self.value = op.value
+                return WRITE_OK
+            return WRITE_FAIL
+        if isinstance(op, Read):
+            return ReadOk(self.value)
+        raise TypeError(f"not a write-once register op: {op!r}")
+
+    def is_valid_step(self, op: Any, ret: Any) -> bool:
+        if isinstance(op, Write):
+            if isinstance(ret, WriteOk):
+                if self.value is None:
+                    self.value = op.value
+                    return True
+                return self.value == op.value
+            if isinstance(ret, WriteFail):
+                return self.value is not None and self.value != op.value
+            return False
+        if isinstance(op, Read) and isinstance(ret, ReadOk):
+            return self.value == ret.value
+        return False
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, WORegister) and self.value == other.value
+
+    def __repr__(self) -> str:
+        return f"WORegister({self.value!r})"
+
+    def __hash__(self) -> int:
+        from ..fingerprint import fingerprint
+
+        return fingerprint(self)
+
+    def fingerprint_key(self):
+        return self.value

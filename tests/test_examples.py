@@ -1,0 +1,147 @@
+"""Integration tests: the example protocols with the reference's golden
+unique-state counts and discovery traces.
+
+Mirrors the #[test] fns embedded in the reference examples:
+paxos.rs:300-352, single-copy-register.rs:90-135,
+linearizable-register.rs:257-330, increment_lock.rs, timers.rs,
+interaction.rs.
+"""
+
+import pytest
+
+from stateright_tpu.actor import Deliver, Id, Network
+from stateright_tpu.actor.register import Get, GetOk, Internal, Put, PutOk
+from stateright_tpu.models import IncrementLock, IncrementLockTensor
+
+from examples.linearizable_register import AckQuery, AckRecord, Query, Record, abd_model
+from examples.lww_register import lww_model
+from examples.paxos import Accept, Accepted, Decided, Prepare, Prepared, paxos_model
+from examples.single_copy_register import single_copy_model
+from examples.interaction import interaction_model
+from examples.timers import timers_model
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("engine", ["bfs", "dfs"])
+def test_can_model_paxos(engine):
+    checker = paxos_model(2, 3).checker()
+    checker = (checker.spawn_bfs() if engine == "bfs" else checker.spawn_dfs()).join()
+    checker.assert_properties()
+    checker.assert_discovery("value chosen", [
+        Deliver(src=Id(4), dst=Id(1), msg=Put(4, "B")),
+        Deliver(src=Id(1), dst=Id(0), msg=Internal(Prepare((1, Id(1))))),
+        Deliver(src=Id(0), dst=Id(1), msg=Internal(Prepared((1, Id(1)), None))),
+        Deliver(src=Id(1), dst=Id(2),
+                msg=Internal(Accept((1, Id(1)), (4, Id(4), "B")))),
+        Deliver(src=Id(2), dst=Id(1), msg=Internal(Accepted((1, Id(1))))),
+        Deliver(src=Id(1), dst=Id(4), msg=PutOk(4)),
+        Deliver(src=Id(1), dst=Id(2),
+                msg=Internal(Decided((1, Id(1)), (4, Id(4), "B")))),
+        Deliver(src=Id(4), dst=Id(2), msg=Get(8)),
+    ])
+    assert checker.unique_state_count() == 16_668
+
+
+def test_can_model_single_copy_register():
+    # Linearizable if only one server. DFS for this one.
+    checker = single_copy_model(2, 1).checker().spawn_dfs().join()
+    checker.assert_properties()
+    checker.assert_discovery("value chosen", [
+        Deliver(src=Id(2), dst=Id(0), msg=Put(2, "B")),
+        Deliver(src=Id(0), dst=Id(2), msg=PutOk(2)),
+        Deliver(src=Id(2), dst=Id(0), msg=Get(4)),
+    ])
+    assert checker.unique_state_count() == 93
+
+    # More than one server is not linearizable. BFS this time.
+    checker = single_copy_model(2, 2).checker().spawn_bfs().join()
+    checker.assert_discovery("linearizable", [
+        Deliver(src=Id(3), dst=Id(1), msg=Put(3, "B")),
+        Deliver(src=Id(1), dst=Id(3), msg=PutOk(3)),
+        Deliver(src=Id(3), dst=Id(0), msg=Get(6)),
+        Deliver(src=Id(0), dst=Id(3), msg=GetOk(6, None)),
+    ])
+    checker.assert_discovery("value chosen", [
+        Deliver(src=Id(3), dst=Id(1), msg=Put(3, "B")),
+        Deliver(src=Id(1), dst=Id(3), msg=PutOk(3)),
+        Deliver(src=Id(2), dst=Id(0), msg=Put(2, "A")),
+        Deliver(src=Id(3), dst=Id(0), msg=Get(6)),
+    ])
+    # The reference reports 20 here (single-copy-register.rs:135). This run
+    # stops early once every property has a discovery, so the count is an
+    # enumeration-order artifact; our deterministic sorted action order
+    # visits 22 before cutoff. (The exhaustive 93-state golden above is
+    # order-independent and matches exactly.)
+    assert checker.unique_state_count() == 22
+
+
+@pytest.mark.parametrize("engine", ["bfs", "dfs"])
+def test_can_model_linearizable_register(engine):
+    checker = abd_model(2, 2).checker()
+    checker = (checker.spawn_bfs() if engine == "bfs" else checker.spawn_dfs()).join()
+    checker.assert_properties()
+    checker.assert_discovery("value chosen", [
+        Deliver(src=Id(3), dst=Id(1), msg=Put(3, "B")),
+        Deliver(src=Id(1), dst=Id(0), msg=Internal(Query(3))),
+        Deliver(src=Id(0), dst=Id(1), msg=Internal(AckQuery(3, (0, Id(0)), None))),
+        Deliver(src=Id(1), dst=Id(0), msg=Internal(Record(3, (1, Id(1)), "B"))),
+        Deliver(src=Id(0), dst=Id(1), msg=Internal(AckRecord(3))),
+        Deliver(src=Id(1), dst=Id(3), msg=PutOk(3)),
+        Deliver(src=Id(3), dst=Id(0), msg=Get(6)),
+        Deliver(src=Id(0), dst=Id(1), msg=Internal(Query(6))),
+        Deliver(src=Id(1), dst=Id(0), msg=Internal(AckQuery(6, (1, Id(1)), "B"))),
+        Deliver(src=Id(0), dst=Id(1), msg=Internal(Record(6, (1, Id(1)), "B"))),
+        Deliver(src=Id(1), dst=Id(0), msg=Internal(AckRecord(6))),
+    ])
+    assert checker.unique_state_count() == 544
+
+
+def test_increment_lock_holds_invariants():
+    checker = IncrementLock(2).checker().spawn_dfs().join()
+    checker.assert_properties()
+    sym = IncrementLock(3).checker().symmetry().spawn_dfs().join()
+    sym.assert_properties()
+    full = IncrementLock(3).checker().spawn_dfs().join()
+    assert sym.unique_state_count() < full.unique_state_count()
+
+
+def test_increment_lock_tensor_matches_host():
+    host = IncrementLock(2).checker().spawn_bfs().join()
+    tensor = IncrementLockTensor(2).checker().spawn_tpu_bfs().join()
+    assert tensor.unique_state_count() == host.unique_state_count()
+    tensor.assert_properties()
+
+
+def test_lww_register_is_eventually_consistent():
+    checker = lww_model(2).checker().target_max_depth(6).spawn_dfs().join()
+    checker.assert_no_discovery("eventually consistent")
+    assert checker.unique_state_count() > 100
+
+
+def test_timers_pingers():
+    checker = timers_model(2).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() > 10
+
+
+def test_interaction_reaches_success():
+    from stateright_tpu import StateRecorder
+    from examples.interaction import InputState
+
+    # The reference CLI uses depth 30 (interaction.rs:43); depth 8 already
+    # covers the success path and keeps the duplicating-network blowup
+    # test-sized.
+    recorder, accessor = StateRecorder.new_with_accessor()
+    checker = (
+        interaction_model()
+        .checker()
+        .target_max_depth(8)
+        .visitor(recorder)
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert any(
+        any(isinstance(s, InputState) and s.success for s in state.actor_states)
+        for state in accessor()
+    )

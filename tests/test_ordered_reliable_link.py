@@ -1,0 +1,81 @@
+"""Ordered-reliable-link tests. Mirrors the test module of
+src/actor/ordered_reliable_link.rs:230-330."""
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Deliver, Id, Network
+from stateright_tpu.actor.ordered_reliable_link import (
+    DeliverMsg,
+    OrderedReliableLink,
+)
+
+
+class Sender(Actor):
+    def __init__(self, receiver_id):
+        self.receiver_id = receiver_id
+
+    def on_start(self, id, out):
+        out.send(self.receiver_id, 42)
+        out.send(self.receiver_id, 43)
+        return ()
+
+    def on_msg(self, id, state, src, msg, out):
+        return state + ((src, msg),)
+
+
+class Receiver(Sender):
+    def __init__(self):
+        pass
+
+    def on_start(self, id, out):
+        return ()
+
+
+def model():
+    def received(state):
+        return state.actor_states[1].wrapped_state
+
+    return (
+        ActorModel()
+        .actor(OrderedReliableLink.with_default_timeout(Sender(Id(1))))
+        .actor(OrderedReliableLink.with_default_timeout(Receiver()))
+        .with_init_network(Network.new_unordered_duplicating())
+        .with_lossy_network(True)
+        .property(
+            Expectation.ALWAYS,
+            "no redelivery",
+            lambda m, s: sum(1 for _, v in received(s) if v == 42) < 2
+            and sum(1 for _, v in received(s) if v == 43) < 2,
+        )
+        .property(
+            Expectation.ALWAYS,
+            "ordered",
+            lambda m, s: all(
+                a[1] <= b[1] for a, b in zip(received(s), received(s)[1:])
+            ),
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "delivered",
+            lambda m, s: received(s) == ((Id(0), 42), (Id(0), 43)),
+        )
+        .with_within_boundary(lambda cfg, s: len(s.network) < 4)
+    )
+
+
+def test_messages_are_not_delivered_twice():
+    model().checker().spawn_bfs().join().assert_no_discovery("no redelivery")
+
+
+def test_messages_are_delivered_in_order():
+    model().checker().spawn_bfs().join().assert_no_discovery("ordered")
+
+
+def test_messages_are_eventually_delivered():
+    checker = model().checker().spawn_bfs().join()
+    checker.assert_discovery(
+        "delivered",
+        [
+            Deliver(src=Id(0), dst=Id(1), msg=DeliverMsg(1, 42)),
+            Deliver(src=Id(0), dst=Id(1), msg=DeliverMsg(2, 43)),
+        ],
+    )

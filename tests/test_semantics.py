@@ -1,0 +1,288 @@
+"""Semantics-layer tests. Mirrors the test modules of
+src/semantics/{register,write_once_register,vec,linearizability,
+sequential_consistency}.rs."""
+
+import pytest
+
+from stateright_tpu.semantics import (
+    LinearizabilityTester,
+    SequentialConsistencyTester,
+)
+from stateright_tpu.semantics import register as reg
+from stateright_tpu.semantics import vec
+from stateright_tpu.semantics import write_once_register as wor
+
+
+# -- reference objects -------------------------------------------------------
+
+def test_register_models_expected_semantics():
+    r = reg.Register("A")
+    assert r.invoke(reg.READ) == reg.ReadOk("A")
+    assert r.invoke(reg.Write("B")) == reg.WRITE_OK
+    assert r.invoke(reg.READ) == reg.ReadOk("B")
+
+
+def test_register_accepts_valid_histories():
+    assert reg.Register("A").is_valid_history([])
+    assert reg.Register("A").is_valid_history([
+        (reg.READ, reg.ReadOk("A")),
+        (reg.Write("B"), reg.WRITE_OK),
+        (reg.READ, reg.ReadOk("B")),
+        (reg.Write("C"), reg.WRITE_OK),
+        (reg.READ, reg.ReadOk("C")),
+    ])
+
+
+def test_register_rejects_invalid_histories():
+    assert not reg.Register("A").is_valid_history([
+        (reg.READ, reg.ReadOk("B")),
+        (reg.Write("B"), reg.WRITE_OK),
+    ])
+    assert not reg.Register("A").is_valid_history([
+        (reg.Write("B"), reg.WRITE_OK),
+        (reg.READ, reg.ReadOk("A")),
+    ])
+
+
+def test_write_once_register_semantics():
+    r = wor.WORegister()
+    assert r.invoke(wor.Write("A")) == wor.WRITE_OK
+    assert r.invoke(wor.READ) == wor.ReadOk("A")
+    assert r.invoke(wor.Write("B")) == wor.WRITE_FAIL
+    assert r.invoke(wor.READ) == wor.ReadOk("A")
+
+    assert wor.WORegister().is_valid_history([
+        (wor.READ, wor.ReadOk(None)),
+        (wor.Write("A"), wor.WRITE_OK),
+        (wor.READ, wor.ReadOk("A")),
+        (wor.Write("B"), wor.WRITE_FAIL),
+        (wor.READ, wor.ReadOk("A")),
+        (wor.Write("C"), wor.WRITE_FAIL),
+        (wor.READ, wor.ReadOk("A")),
+    ])
+    assert not wor.WORegister("A").is_valid_history([
+        (wor.READ, wor.ReadOk("A")),
+        (wor.Write("B"), wor.WRITE_OK),
+    ])
+    assert not wor.WORegister().is_valid_history([
+        (wor.READ, wor.ReadOk("A")),
+        (wor.Write("A"), wor.WRITE_OK),
+    ])
+    assert not wor.WORegister().is_valid_history([
+        (wor.READ, wor.ReadOk(None)),
+        (wor.Write("A"), wor.WRITE_OK),
+        (wor.Write("B"), wor.WRITE_OK),
+    ])
+
+
+def test_vec_semantics():
+    v = vec.VecSpec(["A"])
+    assert v.invoke(vec.Push("B")) == vec.PUSH_OK
+    assert v.invoke(vec.LEN) == vec.LenOk(2)
+    assert v.invoke(vec.POP) == vec.PopOk("B")
+    assert v.invoke(vec.POP) == vec.PopOk("A")
+    assert v.invoke(vec.POP) == vec.PopOk(None)
+    assert v.invoke(vec.LEN) == vec.LenOk(0)
+
+
+# -- linearizability (linearizability.rs:305-470) ----------------------------
+
+def test_rejects_invalid_history():
+    t = LinearizabilityTester(reg.Register("A"))
+    t.on_invoke(99, reg.Write("B"))
+    assert t.is_valid_history
+    t.on_invoke(99, reg.Write("C"))
+    assert not t.is_valid_history
+    assert "already has an operation in flight" in t.last_error
+    assert not t.is_consistent()
+
+    t = LinearizabilityTester(reg.Register("A"))
+    t.on_invret(99, reg.Write("B"), reg.WRITE_OK)
+    t.on_invret(99, reg.Write("C"), reg.WRITE_OK)
+    t.on_return(99, reg.WRITE_OK)
+    assert not t.is_valid_history
+    assert "no in-flight invocation" in t.last_error
+
+
+def test_identifies_linearizable_register_history():
+    t = LinearizabilityTester(reg.Register("A"))
+    t.on_invoke(0, reg.Write("B")).on_invret(1, reg.READ, reg.ReadOk("A"))
+    assert t.serialized_history() == [(reg.READ, reg.ReadOk("A"))]
+
+    t = LinearizabilityTester(reg.Register("A"))
+    t.on_invoke(0, reg.READ).on_invoke(1, reg.Write("B")).on_return(
+        0, reg.ReadOk("B")
+    )
+    assert t.serialized_history() == [
+        (reg.Write("B"), reg.WRITE_OK),
+        (reg.READ, reg.ReadOk("B")),
+    ]
+
+
+def test_identifies_unlinearizable_register_history():
+    t = LinearizabilityTester(reg.Register("A"))
+    t.on_invret(0, reg.READ, reg.ReadOk("B"))
+    assert t.serialized_history() is None
+
+    # SC but not linearizable: the write was invoked after the read returned.
+    t = LinearizabilityTester(reg.Register("A"))
+    t.on_invret(0, reg.READ, reg.ReadOk("B")).on_invoke(1, reg.Write("B"))
+    assert t.serialized_history() is None
+
+
+def test_identifies_linearizable_vec_history():
+    t = LinearizabilityTester(vec.VecSpec())
+    t.on_invoke(0, vec.Push(10))
+    assert t.serialized_history() == []
+
+    t = LinearizabilityTester(vec.VecSpec())
+    t.on_invoke(0, vec.Push(10)).on_invret(1, vec.POP, vec.PopOk(None))
+    assert t.serialized_history() == [(vec.POP, vec.PopOk(None))]
+
+    t = LinearizabilityTester(vec.VecSpec())
+    t.on_invoke(0, vec.Push(10)).on_invret(1, vec.POP, vec.PopOk(10))
+    assert t.serialized_history() == [
+        (vec.Push(10), vec.PUSH_OK),
+        (vec.POP, vec.PopOk(10)),
+    ]
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(0, vec.Push(20))
+        .on_invret(1, vec.LEN, vec.LenOk(1))
+        .on_invret(1, vec.POP, vec.PopOk(20))
+        .on_invret(1, vec.POP, vec.PopOk(10))
+    )
+    assert t.serialized_history() == [
+        (vec.Push(10), vec.PUSH_OK),
+        (vec.LEN, vec.LenOk(1)),
+        (vec.Push(20), vec.PUSH_OK),
+        (vec.POP, vec.PopOk(20)),
+        (vec.POP, vec.PopOk(10)),
+    ]
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(0, vec.Push(20))
+        .on_invret(1, vec.LEN, vec.LenOk(1))
+        .on_invret(1, vec.POP, vec.PopOk(10))
+        .on_invret(1, vec.POP, vec.PopOk(20))
+    )
+    assert t.serialized_history() == [
+        (vec.Push(10), vec.PUSH_OK),
+        (vec.LEN, vec.LenOk(1)),
+        (vec.POP, vec.PopOk(10)),
+        (vec.Push(20), vec.PUSH_OK),
+        (vec.POP, vec.PopOk(20)),
+    ]
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(0, vec.Push(20))
+        .on_invret(1, vec.LEN, vec.LenOk(2))
+        .on_invret(1, vec.POP, vec.PopOk(20))
+        .on_invret(1, vec.POP, vec.PopOk(10))
+    )
+    assert t.serialized_history() == [
+        (vec.Push(10), vec.PUSH_OK),
+        (vec.Push(20), vec.PUSH_OK),
+        (vec.LEN, vec.LenOk(2)),
+        (vec.POP, vec.PopOk(20)),
+        (vec.POP, vec.PopOk(10)),
+    ]
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(1, vec.LEN)
+        .on_invoke(0, vec.Push(20))
+        .on_return(1, vec.LenOk(1))
+    )
+    assert t.serialized_history() == [
+        (vec.Push(10), vec.PUSH_OK),
+        (vec.LEN, vec.LenOk(1)),
+    ]
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(1, vec.LEN)
+        .on_invoke(0, vec.Push(20))
+        .on_return(1, vec.LenOk(2))
+    )
+    assert t.serialized_history() == [
+        (vec.Push(10), vec.PUSH_OK),
+        (vec.Push(20), vec.PUSH_OK),
+        (vec.LEN, vec.LenOk(2)),
+    ]
+
+
+def test_identifies_unlinearizable_vec_history():
+    # SC but not linearizable.
+    t = LinearizabilityTester(vec.VecSpec())
+    t.on_invret(0, vec.Push(10), vec.PUSH_OK).on_invret(1, vec.POP, vec.PopOk(None))
+    assert t.serialized_history() is None
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(1, vec.LEN)
+        .on_invoke(0, vec.Push(20))
+        .on_return(1, vec.LenOk(0))
+    )
+    assert t.serialized_history() is None
+
+    t = LinearizabilityTester(vec.VecSpec())
+    (
+        t.on_invret(0, vec.Push(10), vec.PUSH_OK)
+        .on_invoke(0, vec.Push(20))
+        .on_invret(1, vec.LEN, vec.LenOk(2))
+        .on_invret(1, vec.POP, vec.PopOk(10))
+        .on_invret(1, vec.POP, vec.PopOk(20))
+    )
+    assert t.serialized_history() is None
+
+
+# -- sequential consistency --------------------------------------------------
+
+def test_sc_accepts_what_linearizability_rejects():
+    # Stale read after a completed write: SC yes, linearizable no.
+    lin = LinearizabilityTester(reg.Register("A"))
+    lin.on_invret(0, reg.Write("B"), reg.WRITE_OK).on_invret(
+        1, reg.READ, reg.ReadOk("A")
+    )
+    assert lin.serialized_history() is None
+
+    sc = SequentialConsistencyTester(reg.Register("A"))
+    sc.on_invret(0, reg.Write("B"), reg.WRITE_OK).on_invret(
+        1, reg.READ, reg.ReadOk("A")
+    )
+    assert sc.serialized_history() == [
+        (reg.READ, reg.ReadOk("A")),
+        (reg.Write("B"), reg.WRITE_OK),
+    ]
+
+
+def test_sc_still_requires_per_thread_order():
+    sc = SequentialConsistencyTester(reg.Register("A"))
+    sc.on_invret(0, reg.READ, reg.ReadOk("B")).on_invret(
+        0, reg.Write("B"), reg.WRITE_OK
+    )
+    assert sc.serialized_history() is None
+
+
+def test_testers_are_value_objects():
+    from stateright_tpu import fingerprint
+
+    t1 = LinearizabilityTester(reg.Register("A"))
+    t1.on_invoke(0, reg.Write("B"))
+    t2 = t1.copy()
+    assert t1 == t2
+    assert fingerprint(t1) == fingerprint(t2)
+    t2.on_return(0, reg.WRITE_OK)
+    assert t1 != t2
+    assert fingerprint(t1) != fingerprint(t2)
+    assert len(t1) == 1 and len(t2) == 1

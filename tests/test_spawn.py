@@ -1,0 +1,92 @@
+"""UDP spawn runtime tests: the checked actor code runs over real sockets.
+
+Role parity: the reference's spawn runtime is smoke-tested by hand
+(SURVEY.md §4.4); here the background-handle capability makes it properly
+testable: a ping-pong pair converges over loopback UDP, and timers fire.
+"""
+
+import time
+
+import pytest
+
+from stateright_tpu.actor import Actor, Id, Out
+from stateright_tpu.actor.spawn import (
+    json_serializer,
+    make_json_deserializer,
+    spawn,
+)
+from stateright_tpu.actor.test_util import Ping, PingPongActor, Pong
+
+
+def _wait_until(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def test_ping_pong_over_udp():
+    base = 42000
+    a = Id.from_addr("127.0.0.1", base)
+    b = Id.from_addr("127.0.0.1", base + 1)
+    handle = spawn(
+        json_serializer,
+        make_json_deserializer(Ping, Pong),
+        [(a, PingPongActor(serve_to=b)), (b, PingPongActor())],
+        background=True,
+    )
+    try:
+        # Counters climb as the pair bounces Ping/Pong over loopback.
+        assert _wait_until(lambda: handle.state(a) >= 3 and handle.state(b) >= 3)
+    finally:
+        handle.shutdown()
+
+
+def test_timers_fire():
+    class TickActor(Actor):
+        def on_start(self, id, out):
+            out.set_timer("tick", (0.01, 0.02))
+            return 0
+
+        def on_timeout(self, id, state, timer, out):
+            out.set_timer("tick", (0.01, 0.02))
+            return state + 1
+
+    addr = Id.from_addr("127.0.0.1", 42010)
+    handle = spawn(
+        json_serializer,
+        make_json_deserializer(),
+        [(addr, TickActor())],
+        background=True,
+    )
+    try:
+        assert _wait_until(lambda: handle.state(addr) >= 3)
+    finally:
+        handle.shutdown()
+
+
+def test_random_choice_resolves():
+    class RandomActor(Actor):
+        def on_start(self, id, out):
+            out.choose_random("pick", ["x", "y"])
+            return None
+
+        def on_random(self, id, state, random, out):
+            return random
+
+    addr = Id.from_addr("127.0.0.1", 42011)
+    handle = spawn(
+        json_serializer,
+        make_json_deserializer(),
+        [(addr, RandomActor())],
+        background=True,
+    )
+    try:
+        # ChooseRandom schedules the pick up to 10s out (spawn.rs:222-231);
+        # just assert the actor is running and the loop handles the queue.
+        time.sleep(0.1)
+        assert handle.state(addr) in (None, "x", "y")
+    finally:
+        handle.shutdown()

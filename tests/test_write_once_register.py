@@ -1,0 +1,97 @@
+"""Write-once register actor kit tests: a trivial first-write-wins server
+checked against the WORegister semantics via the kit's history hooks.
+
+Role parity: the reference exercises this kit through its examples; here a
+minimal server validates client sequencing (PutFail advances like PutOk,
+write_once_register.rs:247-266) and the record hooks end-to-end.
+"""
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Network
+from stateright_tpu.actor.write_once_register import (
+    Get,
+    GetOk,
+    Put,
+    PutFail,
+    PutOk,
+    WORegisterClient,
+    record_invocations,
+    record_returns,
+)
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.write_once_register import WORegister
+
+
+class FirstWriteWinsServer(Actor):
+    """Accepts only the first write; later writes of other values fail."""
+
+    def on_start(self, id, out):
+        return None
+
+    def on_msg(self, id, state, src, msg, out):
+        if isinstance(msg, Put):
+            if state is None or state == msg.value:
+                out.send(src, PutOk(msg.request_id))
+                return msg.value
+            out.send(src, PutFail(msg.request_id))
+            return None
+        if isinstance(msg, Get):
+            out.send(src, GetOk(msg.request_id, state))
+            return None
+        return None
+
+
+def wo_model(client_count: int):
+    return (
+        ActorModel(init_history=LinearizabilityTester(WORegister()))
+        .actor(FirstWriteWinsServer())
+        .add_actors(
+            WORegisterClient(put_count=1, server_count=1)
+            for _ in range(client_count)
+        )
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda model, state: state.history.serialized_history() is not None,
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "a write fails",
+            lambda model, state: any(
+                isinstance(env.msg, PutFail)
+                for env in state.network.iter_deliverable()
+            ),
+        )
+        .with_record_msg_in(record_returns)
+        .with_record_msg_out(record_invocations)
+    )
+
+
+def test_single_server_write_once_is_linearizable():
+    checker = wo_model(2).checker().spawn_bfs().join()
+    checker.assert_properties()  # linearizable + a conflicting write fails
+
+
+def test_clients_advance_past_put_fail():
+    # Both clients finish their op sequences even when one Put fails.
+    from stateright_tpu import StateRecorder
+
+    recorder, accessor = StateRecorder.new_with_accessor()
+    wo_model(2).checker().visitor(recorder).spawn_bfs().join()
+    assert any(
+        all(
+            getattr(s, "awaiting", "x") is None
+            for s in state.actor_states[1:]
+        )
+        for state in accessor()
+    )
+
+
+def test_symmetry_representative_rewrites_wo_states():
+    from stateright_tpu.fingerprint import fingerprint
+
+    model = wo_model(2)
+    init = model.init_states()[0]
+    rep = init.representative()
+    assert fingerprint(rep) == fingerprint(rep.representative())  # idempotent
