@@ -1,0 +1,9 @@
+"""The Explorer: an interactive web UI over an on-demand checking run.
+
+Reference parity: src/checker/explorer.rs (JSON API) + ui/ (SPA). See
+`server.serve` for the HTTP surface.
+"""
+
+from .server import ExplorerServer, serve
+
+__all__ = ["ExplorerServer", "serve"]

@@ -1,0 +1,287 @@
+"""Explorer web service: a JSON API + SPA over an on-demand checker.
+
+Reference parity: src/checker/explorer.rs. Routes:
+
+  - ``GET /``, ``/app.css``, ``/app.js`` — the bundled single-page UI;
+  - ``GET /.status`` — checker progress + per-property discovery paths
+    (StatusView, explorer.rs:15-24);
+  - ``GET /.states/{fp}/{fp}/...`` — walk the state space by fingerprint
+    path: returns the successor `StateView`s of the path's final state,
+    asking the on-demand checker to expand that frontier node in the
+    background (explorer.rs:224-320);
+  - ``POST /.runtocompletion`` — switch the checker to exhaustive search.
+
+A snapshot visitor records a recently visited path every ~4 seconds so the
+UI can show live activity (explorer.rs:60-94).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path as FsPath
+from typing import Any, Dict, List, Optional
+
+from ..checker import Checker, CheckerBuilder
+from ..core import Model
+from ..path import Path
+
+_UI_DIR = FsPath(__file__).parent / "ui"
+_SNAPSHOT_REFRESH_SECS = 4.0  # explorer.rs:90-93
+
+
+class _Snapshot:
+    """Records one visited path, rearmed periodically (explorer.rs:60-76)."""
+
+    def __init__(self):
+        self._armed = True
+        self._actions: Optional[List[Any]] = None
+        self._lock = threading.Lock()
+
+    def visit(self, model, path) -> None:
+        with self._lock:
+            if self._armed:
+                self._armed = False
+                self._actions = path.into_actions()
+
+    def rearm(self) -> None:
+        with self._lock:
+            self._armed = True
+
+    def recent(self) -> Optional[str]:
+        with self._lock:
+            return None if self._actions is None else repr(self._actions)
+
+
+def _properties_view(checker: Checker, model: Model) -> List[List[Any]]:
+    """(expectation, name, encoded discovery path) triples (explorer.rs:203-221)."""
+    out = []
+    for prop in model.properties():
+        discovery = checker.discovery(prop.name)
+        out.append(
+            [
+                prop.expectation.value,
+                prop.name,
+                discovery.encode(model) if discovery is not None else None,
+            ]
+        )
+    return out
+
+
+def _status_view(checker: Checker, model: Model, snapshot: _Snapshot) -> Dict:
+    return {
+        "done": checker.is_done(),
+        "model": type(model).__name__,
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "properties": _properties_view(checker, model),
+        "recent_path": snapshot.recent(),
+    }
+
+
+def _state_view(
+    checker: Checker,
+    model: Model,
+    fingerprints: List[int],
+    state: Any,
+    action: Optional[Any],
+    outcome: Optional[str],
+) -> Dict:
+    fp = model.fingerprint_state(state)
+    checker.check_fingerprint(fp)  # expand in the background
+    svg = None
+    try:
+        svg = model.as_svg(Path.from_fingerprints(model, fingerprints + [fp]))
+    except Exception:
+        pass  # diagram is best-effort
+    view: Dict[str, Any] = {
+        "state": _pretty(state),
+        "fingerprint": str(fp),
+        "properties": _properties_view(checker, model),
+    }
+    if action is not None:
+        view["action"] = model.format_action(action)
+    if outcome is not None:
+        view["outcome"] = outcome
+    if svg is not None:
+        view["svg"] = svg
+    return view
+
+
+def _pretty(state: Any) -> str:
+    text = repr(state)
+    if len(text) <= 80:
+        return text
+    # Cheap pretty-printer: break on commas at bracket depth transitions.
+    out, depth, indent = [], 0, "  "
+    for ch in text:
+        if ch in "([{":
+            depth += 1
+            out.append(ch + "\n" + indent * depth)
+        elif ch in ")]}":
+            depth -= 1
+            out.append("\n" + indent * depth + ch)
+        elif ch == "," :
+            out.append(",\n" + indent * depth)
+        else:
+            out.append(ch)
+    return "".join(out).replace(" \n", "\n")
+
+
+def states_views(checker: Checker, fingerprints_path: str) -> List[Dict]:
+    """Handler for GET /.states/... (testable without a socket).
+
+    Reference: states() at explorer.rs:224-320.
+    """
+    model = checker.model()
+    cleaned = fingerprints_path.strip("/")
+    fingerprints: List[int] = []
+    if cleaned:
+        for part in cleaned.split("/"):
+            try:
+                fingerprints.append(int(part))
+            except ValueError:
+                raise KeyError(f"Unable to parse fingerprints {cleaned}")
+
+    results: List[Dict] = []
+    if not fingerprints:
+        for state in model.init_states():
+            results.append(_state_view(checker, model, [], state, None, None))
+        return results
+
+    last_state = Path.final_state(model, fingerprints)
+    if last_state is None:
+        raise KeyError(f"Unable to find state following fingerprints {cleaned}")
+    actions: List[Any] = []
+    model.actions(last_state, actions)
+    for action in actions:
+        outcome = model.format_step(last_state, action)
+        next_state = model.next_state(last_state, action)
+        if next_state is not None:
+            results.append(
+                _state_view(checker, model, fingerprints, next_state, action, outcome)
+            )
+        else:
+            # "Action ignored" is still returned for debugging
+            # (explorer.rs:299-307).
+            results.append(
+                {
+                    "action": model.format_action(action),
+                    "properties": _properties_view(checker, model),
+                }
+            )
+    return results
+
+
+class ExplorerServer:
+    """A running Explorer; `serve()` constructs it."""
+
+    def __init__(self, builder: CheckerBuilder, address: str):
+        self.snapshot = _Snapshot()
+        builder.visitor(self.snapshot.visit)
+        self.checker = builder.spawn_on_demand()
+        self.model = self.checker.model()
+
+        host, _, port = address.replace("localhost", "127.0.0.1").partition(":")
+        self.address = (host or "127.0.0.1", int(port or 3000))
+
+        self._rearm_thread = threading.Thread(target=self._rearm_loop, daemon=True)
+        self._stop = threading.Event()
+
+        explorer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass  # quiet
+
+            def _send(self, code: int, body: bytes, content_type: str):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, payload, code=200):
+                self._send(code, json.dumps(payload).encode(), "application/json")
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/.status":
+                    self._send_json(
+                        _status_view(explorer.checker, explorer.model, explorer.snapshot)
+                    )
+                elif path.startswith("/.states"):
+                    try:
+                        self._send_json(
+                            states_views(explorer.checker, path[len("/.states"):])
+                        )
+                    except KeyError as e:
+                        self._send(404, str(e).encode(), "text/plain")
+                elif path in ("/", "/index.htm", "/index.html"):
+                    self._ui_file("index.html", "text/html")
+                elif path == "/app.js":
+                    self._ui_file("app.js", "application/javascript")
+                elif path == "/app.css":
+                    self._ui_file("app.css", "text/css")
+                else:
+                    self._send(404, b"", "text/plain")
+
+            def _ui_file(self, name: str, content_type: str):
+                try:
+                    self._send(200, (_UI_DIR / name).read_bytes(), content_type)
+                except OSError:
+                    self._send(404, b"missing UI file", "text/plain")
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] == "/.runtocompletion":
+                    explorer.checker.run_to_completion()
+                    self._send(200, b"", "text/plain")
+                else:
+                    self._send(404, b"", "text/plain")
+
+        self.httpd = ThreadingHTTPServer(self.address, Handler)
+
+    def _rearm_loop(self):
+        while not self._stop.wait(_SNAPSHOT_REFRESH_SECS):
+            self.snapshot.rearm()
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}/"
+
+    def serve_forever(self):
+        print(f"Explorer ready. {self.url}")
+        self._rearm_thread.start()
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def serve_in_background(self) -> "ExplorerServer":
+        self._rearm_thread.start()
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve(builder: CheckerBuilder, address: str, block: bool = True):
+    """Start the Explorer. Reference: serve() (explorer.rs:79-99).
+
+    With `block=False` the server runs on daemon threads and the handle is
+    returned (a testability capability the reference lacks).
+    """
+    server = ExplorerServer(builder, address)
+    if block:
+        server.serve_forever()
+        return server.checker
+    return server.serve_in_background()
