@@ -135,6 +135,12 @@ class ActorModel(Model):
         """With three arguments: add a property (builder, model.rs:143-157).
         With one string argument: look it up (the `Model.property` accessor)."""
         if name is None and condition is None:
+            if not isinstance(expectation, str):
+                raise TypeError(
+                    "ActorModel.property(expectation, name, condition) adds a "
+                    "property; the single-argument form looks one up by name "
+                    f"and requires a string, got {type(expectation).__name__}"
+                )
             return Model.property(self, expectation)
         self._properties.append(Property(expectation, name, condition))
         return self
@@ -203,6 +209,11 @@ class ActorModel(Model):
                 actions.append(Drop(env))
             if int(env.dst) < len(self.actors):  # ignored if recipient DNE
                 if is_ordered:
+                    # Vestigial parity with model.rs:269-275: our Ordered
+                    # network's iter_deliverable already yields only one head
+                    # envelope per flow, so consecutive envelopes never share
+                    # a channel; kept as defense-in-depth should that
+                    # iterator ever change.
                     channel = (env.src, env.dst)
                     if prev_channel == channel:
                         continue  # queued behind the previous message

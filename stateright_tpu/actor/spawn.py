@@ -11,11 +11,16 @@ message types out of the box.
 
 Two engines run this event loop:
 
-  - the portable Python threading engine (`spawn`, default), and
-  - a native C++ event-loop core (`stateright_tpu.native`, used when built)
-    that owns the sockets, deadline heap, and poll loop, calling back into
-    the actor only for the protocol logic — the analogue of the reference
-    keeping its runtime in compiled code.
+  - the portable Python threading engine (this module), and
+  - the native C++ event-loop core (`stateright_tpu/native/core.cpp`,
+    compiled to `_core.so` by `python -m stateright_tpu.native.build` and
+    auto-built on first use when a C++ compiler is available) that owns the
+    sockets, deadline map, and poll loop, calling back into Python only for
+    the protocol logic — the analogue of the reference keeping its runtime
+    in compiled code (spawn.rs:64-154).
+
+`engine="auto"` (default) prefers the native core and falls back to Python
+threads; `"native"` / `"python"` force one.
 """
 
 from __future__ import annotations
@@ -23,10 +28,13 @@ from __future__ import annotations
 import dataclasses
 import importlib
 import json
+import logging
 import socket
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 from .base import Actor, CancelTimer, ChooseRandom, Out, Send, SetTimer
 from .ids import Id, addr_from_id
@@ -106,12 +114,21 @@ class _ActorLoop:
         if isinstance(cmd, Send):
             try:
                 payload = self.serialize(cmd.msg)
-            except Exception as e:  # unserializable: ignore (spawn.rs:178-186)
+            except Exception as e:
+                # Dropped like the reference, but logged (spawn.rs:178-186
+                # logs these events); silent drops make network debugging
+                # miserable.
+                log.warning(
+                    "actor %s: failed to serialize %r to %s: %s",
+                    self.id, cmd.msg, cmd.dst, e,
+                )
                 return
             try:
                 self.sock.sendto(payload, addr_from_id(cmd.dst))
-            except OSError:
-                pass  # fire-and-forget (spawn.rs:188-196)
+            except OSError as e:
+                log.warning(
+                    "actor %s: sendto %s failed: %s", self.id, cmd.dst, e
+                )  # fire-and-forget (spawn.rs:188-196)
         elif isinstance(cmd, SetTimer):
             lo, hi = cmd.duration
             duration = _random.uniform(lo, hi) if lo < hi else lo

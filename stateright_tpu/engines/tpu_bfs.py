@@ -4,18 +4,26 @@ This is the framework's reason to exist (SURVEY.md §7, BASELINE.json north
 star): the reference's per-thread hot loop — pop a state, evaluate
 properties, enumerate actions, fingerprint successors, dedup against a
 concurrent map (src/checker/bfs.rs:196-334) — re-designed as a data-parallel
-frontier program:
+frontier program that lives on the device:
 
-  - the pending queue is a device-resident ring buffer of fixed-width
-    uint32 state rows (+ per-row eventually-bits and depth),
-  - each step pops a CHUNK of rows and runs one fused XLA program:
-    batched property evaluation, batched successor generation
-    (`TensorModel.step_batch`), vectorized 64-bit fingerprinting,
-    sort-based in-batch dedup, scatter-claim insertion into the
-    open-addressing visited table, stable compaction, and ring append,
-  - the host thread only orchestrates: it reads a few scalars per step
-    (new/generated counts, discovery flags), applies finish policies,
-    grows the hash table, and spills/refills the queue if it overflows.
+  - the pending queue is a device-resident ring buffer in structure-of-
+    arrays form: one dense [qcap] uint32 array per state lane plus lanes
+    for the fingerprint halves, eventually-bits, and depth — states are
+    hashed exactly once, when first enqueued,
+  - one BFS step pops a CHUNK of rows and runs batched property
+    evaluation, batched successor generation (`TensorModel.step_lanes`),
+    vectorized 64-bit fingerprinting, claim-arbitrated insertion into the
+    SoA open-addressing visited table (in-batch dedup falls out of the
+    claim protocol — no sorting), and a cumsum-compacted ring append,
+  - MANY steps run back-to-back inside a single `lax.while_loop` on the
+    device; the host thread synchronizes only when the loop exits — queue
+    near overflow (spill to host), table near full (grow + rehash), a new
+    property discovery (finish-policy check), a step budget (progress
+    reporting / timeout / state-count targets), or frontier exhaustion.
+
+Everything stays in flat 1-D uint32 arrays because TPU vector tiling makes
+gathers/scatters of [N, small] rows catastrophically slow (>1000x measured
+vs per-lane access) — see ops/visited_set.py.
 
 Semantics match the reference engine state-for-state (same property
 timing, terminal rule, eventually-bit propagation, boundary filtering,
@@ -28,9 +36,19 @@ drive the same TLC-style path reconstruction (bfs.rs:380-409).
 from __future__ import annotations
 
 import functools
+import os
+import sys
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+_DEBUG = bool(os.environ.get("STPU_DEBUG"))
+
+
+def _dbg(msg: str) -> None:
+    if _DEBUG:
+        print(f"[tpu_bfs {time.monotonic():.3f}] {msg}", file=sys.stderr, flush=True)
 
 from ..checker import CheckerBuilder
 from ..core import Expectation
@@ -40,81 +58,277 @@ from ..tensor import TensorModel, TensorModelAdapter
 from .common import HostEngineBase
 
 
-# Step cache: (id(tm), chunk) -> (tm ref, jitted step). Reusing the same
-# function object across checker instances is what lets JAX's trace cache
-# and the persistent compilation cache actually hit (a fresh closure per
+# Loop cache: (id(tm), chunk, qcap, n_props) -> (tm ref, jitted loop). Reusing
+# the same function object across checker instances is what lets JAX's trace
+# cache and the persistent compilation cache actually hit (a fresh closure per
 # checker would recompile every run).
-_STEP_CACHE: Dict[Tuple[int, int], Tuple[TensorModel, Any]] = {}
+_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
-def _build_step(tm: TensorModel, props, chunk: int):
-    """Compile the per-chunk BFS step for a given model and chunk size.
+# Packed scalar-parameter layout. On a remote-attached TPU every individual
+# host<->device transfer costs a full tunnel round-trip (~100ms measured), so
+# ALL scalar state crosses in ONE uint32 vector per direction. The loop reads
+# [0:8], passes the config fields through, and writes the stats tail — so its
+# own output can be fed straight back in with zero uploads when the host has
+# nothing to change.
+P_HEAD = 0  # ring head index
+P_COUNT = 1  # frontier row count
+P_UNIQUE = 2  # unique states so far
+P_REC = 3  # recorded-discovery bitmask (bit i = property i)
+P_DEPTH_LIMIT = 4
+P_GROW_LIMIT = 5  # gate closes when unique exceeds this
+P_HIGH_WATER = 6  # gate closes when count exceeds this
+P_MAX_STEPS = 7  # fori trip count per block
+P_GEN = 8  # OUT: generated states this block
+P_MAXD = 9  # OUT: max depth seen this block
+P_STEPS = 10  # OUT: gated steps actually executed this block
+P_ERR = 11  # OUT: 1 = probe budget exhausted (table overfull)
+P_LEN = 12
 
-    Returns a jitted function:
-      (table, queue, q_ebits, q_depth, head, count, depth_limit) ->
-      (table, queue, q_ebits, q_depth,
-       generated, new_count, unresolved, max_depth_seen,
-       prop_found[P], prop_fp1[P], prop_fp2[P])
+
+def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
+    """Compile the multi-step BFS device loop.
+
+    Returns a jitted function
+      (table, queue, rec_fp1, rec_fp2, params[P_LEN])
+      -> (table, queue, rec_fp1, rec_fp2, params[P_LEN])
+    that runs up to params[P_MAX_STEPS] BFS steps, gating on the host-
+    intervention conditions. `table` is the visited-set lane tuple; `queue`
+    is the ring lane tuple; `params` is the packed scalar vector above.
     """
-    cached = _STEP_CACHE.get((id(tm), chunk))
+    key = (id(tm), chunk, qcap, len(props))
+    cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
 
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
     from ..ops.expand import build_eval_and_expand
 
+    S = tm.state_width
     A = tm.max_actions
+    P = len(props)
     eval_and_expand = build_eval_and_expand(tm, props, chunk)
+    qmask = qcap - 1
+    # Probe-batch width: sized for typical distinct-candidate counts; the
+    # take_cap mechanism adapts when a model's step exceeds it.
+    rcap = max(64 * A, (chunk * A) // 8)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-    def step(table, queue, q_ebits, q_depth, head, count, depth_limit):
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def loop(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
-        qcap = queue.shape[0]
-        qmask = u(qcap - 1)
-        take = jnp.minimum(count, u(chunk))
-        active = jnp.arange(chunk, dtype=jnp.uint32) < take
-        rows, slots = fr.ring_gather(queue, head, chunk)
-        ebits = q_ebits[slots]
-        depth = q_depth[slots]
+        head0 = params[P_HEAD]
+        count0 = params[P_COUNT]
+        unique0 = params[P_UNIQUE]
+        rec_bits = params[P_REC]
+        depth_limit = params[P_DEPTH_LIMIT]
+        grow_limit = params[P_GROW_LIMIT]
+        high_water = params[P_HIGH_WATER]
+        max_steps = params[P_MAX_STEPS]
+        # The outer loop is a COUNTED fori_loop, not a data-dependent
+        # while_loop: on a remote-attached TPU a top-level while predicate is
+        # fetched by the host every iteration (~100-200ms round-trip each),
+        # whereas a counted loop runs entirely on-device. Host-intervention
+        # conditions become a gate predicate inside the body — once it goes
+        # false, remaining iterations are masked no-ops (take = 0, so every
+        # effect is disabled); the host reads the exit state after the block.
+        #
+        # Inside the body only uint32 sum-reduction chains may feed values
+        # that GATE the next iteration (count/unique-style); a gate routed
+        # through a boolean any()-derived carry serializes the pipeline at
+        # ~1.5s per step (measured), as do reduction -> broadcast ->
+        # reduction chains anywhere in the carry (argmax selects, one-hot
+        # extractions, max reduces) at ~200ms per iteration. Discovery
+        # fingerprints are therefore accumulated as per-position lane
+        # snapshots (first hit per position wins, pure elementwise) and
+        # extracted once AFTER the loop; discoveries and insert errors do
+        # NOT close the gate — the host acts on them at block granularity,
+        # exactly like the reference's between-block finish checks
+        # (bfs.rs:134-144).
+        def body(_i, carry):
+            (
+                table,
+                queue,
+                head,
+                count,
+                unique,
+                gen,
+                steps,
+                err_cnt,
+                take_cap,
+                hseen,
+                facc1,
+                facc2,
+                faccd,
+            ) = carry
+            pred = (
+                (count > 0) & (count <= high_water) & (unique <= grow_limit)
+            )
+            take = jnp.where(
+                pred, jnp.minimum(jnp.minimum(count, u(chunk)), take_cap), u(0)
+            )
+            active = jnp.arange(chunk, dtype=jnp.uint32) < take
+            popped, _idx = fr.ring_gather(queue, head, chunk)
+            rows = popped[:S]
+            row_h1 = popped[S]
+            row_h2 = popped[S + 1]
+            ebits = popped[S + 2]
+            depth = popped[S + 3]
 
-        ex = eval_and_expand(rows, ebits, depth, active, depth_limit)
+            ex = eval_and_expand(
+                rows, row_h1, row_h2, ebits, depth, active, depth_limit
+            )
+            # In-batch pre-dedup: only first occurrences probe the visited
+            # table, and the insert probes a compacted [rcap] batch. On this
+            # platform probe gathers cost time proportional to their WIDTH
+            # (~40ns/element regardless of index locality), so probe traffic
+            # must scale with the number of distinct candidates, not the
+            # padded C*A batch width.
+            reps = fr.dedup_mask(ex.h1, ex.h2, ex.valid)
+            table, is_new, unresolved, n_ovf = vs.insert(
+                table, ex.h1, ex.h2, ex.parent1, ex.parent2, reps, rcap=rcap
+            )
+            err_cnt = err_cnt + unresolved.sum(dtype=jnp.uint32)
+            new_count = is_new.sum(dtype=jnp.uint32)
 
-        keep = fr.dedup_mask(ex.h1, ex.h2, ex.valid)
-        table, is_new, unresolved = vs.insert(
-            table, ex.h1, ex.h2, ex.parent1, ex.parent2, keep
-        )
+            # Overflow (> rcap distinct candidates) => PARTIAL step: the
+            # probed prefix is inserted and enqueued (inserts are
+            # idempotent and enqueue==inserted keeps them exactly-once),
+            # but the pops are NOT consumed — the same parents re-expand
+            # with a halved take_cap until everything fits. take_cap creeps
+            # back up on success.
+            ovf = n_ovf > 0
+            cand = ex.flat + (ex.h1, ex.h2, ex.child_ebits, ex.child_depth)
+            tail = (head + count) & u(qmask)
+            queue = fr.ring_scatter(queue, tail, cand, is_new)
 
-        order, new_count = fr.compact_indices(is_new)
-        slot_valid = jnp.arange(chunk * A, dtype=jnp.uint32) < new_count
-        tail = (head + count) & qmask
-        queue = fr.ring_scatter(queue, tail, ex.flat[order], slot_valid)
-        q_ebits = fr.ring_scatter(
-            q_ebits[:, None], tail, ex.child_ebits[order][:, None], slot_valid
-        )[:, 0]
-        q_depth = fr.ring_scatter(
-            q_depth[:, None], tail, ex.child_depth[order][:, None], slot_valid
-        )[:, 0]
+            consumed = jnp.where(ovf, u(0), take)
+            head = (head + consumed) & u(qmask)
+            count = count - consumed + new_count
+            unique = unique + new_count
+            gen = gen + jnp.where(ovf, u(0), ex.generated)
+            steps = steps + (pred & ~ovf).astype(jnp.uint32)
+            take_cap = jnp.where(
+                ovf,
+                jnp.maximum(take >> u(1), u(1)),
+                jnp.minimum(take_cap + u(max(1, chunk // 16)), u(chunk)),
+            )
 
-        return (
+            if P:
+                hseen_n = []
+                facc1_n = []
+                facc2_n = []
+                faccd_n = []
+                for i in range(P):
+                    hits = ex.prop_hits[i]
+                    first = hits & ~hseen[i]
+                    facc1_n.append(jnp.where(first, row_h1, facc1[i]))
+                    facc2_n.append(jnp.where(first, row_h2, facc2[i]))
+                    faccd_n.append(jnp.where(first, depth, faccd[i]))
+                    hseen_n.append(hseen[i] | hits)
+                hseen = tuple(hseen_n)
+                facc1 = tuple(facc1_n)
+                facc2 = tuple(facc2_n)
+                faccd = tuple(faccd_n)
+
+            return (
+                table,
+                queue,
+                head,
+                count,
+                unique,
+                gen,
+                steps,
+                err_cnt,
+                take_cap,
+                hseen,
+                facc1,
+                facc2,
+                faccd,
+            )
+
+        zero_lane = jnp.zeros(chunk, dtype=jnp.uint32) + (head0 & u(0))
+        false_lane = zero_lane != 0
+        init = (
             table,
             queue,
-            q_ebits,
-            q_depth,
-            ex.generated,
-            new_count,
-            unresolved.sum(dtype=jnp.uint32),
-            ex.max_depth_seen,
-            ex.prop_found,
-            ex.prop_fp1,
-            ex.prop_fp2,
+            head0,
+            count0,
+            unique0,
+            u(0),  # generated delta
+            u(0),  # steps actually executed (gate was open)
+            u(0),  # unresolved-insert count (checked at block end)
+            u(chunk),  # take_cap (self-tunes on rcap overflow)
+            tuple(false_lane for _ in range(P)),
+            tuple(zero_lane for _ in range(P)),
+            tuple(zero_lane for _ in range(P)),
+            tuple(zero_lane for _ in range(P)),
         )
+        (
+            table,
+            queue,
+            head,
+            count,
+            unique,
+            gen,
+            steps,
+            err_cnt,
+            _take_cap,
+            hseen,
+            facc1,
+            facc2,
+            faccd,
+        ) = lax.fori_loop(jnp.uint32(0), max_steps, body, init)
 
-    _STEP_CACHE[(id(tm), chunk)] = (tm, step)
-    return step
+        # Block-level epilogue (runs ONCE per block, outside the loop, where
+        # argmax / dynamic gathers are cheap): extract discovery fingerprints
+        # from the snapshots and the max depth from the ring. Depth along the
+        # ring is non-decreasing, so the deepest state visited is the last
+        # one popped, at ring slot head-1.
+        rec_bits_out = rec_bits
+        for i in range(P):
+            found = jnp.any(hseen[i])
+            # Select the SHALLOWEST snapshot hit, not an arbitrary one: BFS
+            # must report a shortest counterexample even when later, deeper
+            # iterations hit the property at other chunk positions.
+            sel = jnp.argmin(
+                jnp.where(hseen[i], faccd[i], u(0xFFFFFFFF))
+            )
+            take_new = found & (((rec_bits_out >> u(i)) & u(1)) == u(0))
+            rec_fp1 = rec_fp1.at[i].set(
+                jnp.where(take_new, facc1[i][sel], rec_fp1[i])
+            )
+            rec_fp2 = rec_fp2.at[i].set(
+                jnp.where(take_new, facc2[i][sel], rec_fp2[i])
+            )
+            rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
+        maxd = jnp.where(
+            steps > 0, queue[S + 3][(head - u(1)) & u(qmask)], u(0)
+        )
+        params_out = jnp.stack(
+            [
+                head,
+                count,
+                unique,
+                rec_bits_out,
+                depth_limit,
+                grow_limit,
+                high_water,
+                max_steps,
+                gen,
+                maxd,
+                steps,
+                (err_cnt > 0).astype(u),
+            ]
+        )
+        return table, queue, rec_fp1, rec_fp2, params_out
+
+    _LOOP_CACHE[key] = (tm, loop)
+    return loop
 
 
 class TpuBfsChecker(HostEngineBase):
@@ -124,9 +338,10 @@ class TpuBfsChecker(HostEngineBase):
         self,
         builder: CheckerBuilder,
         *,
-        chunk_size: int = 4096,
-        queue_capacity: int = 1 << 17,
-        table_capacity: int = 1 << 20,
+        chunk_size: int = 8192,
+        queue_capacity: int = 1 << 20,
+        table_capacity: int = 1 << 22,
+        sync_steps: int = 512,
     ):
         model = builder.model
         if isinstance(model, TensorModel):
@@ -150,6 +365,10 @@ class TpuBfsChecker(HostEngineBase):
         )
         if n_event > 32:
             raise ValueError("at most 32 eventually-properties supported")
+        if len(self._tprops) > 32:
+            # The recorded-discovery set crosses the host boundary as one
+            # uint32 bitmask (see the packed-params layout above).
+            raise ValueError("at most 32 tensor properties supported")
         if queue_capacity & (queue_capacity - 1):
             raise ValueError("queue_capacity must be a power of two")
         # qcap >= 2*C*A guarantees (a) the ring append never wraps over
@@ -163,12 +382,13 @@ class TpuBfsChecker(HostEngineBase):
             raise ValueError("queue_capacity too small for this model's fanout")
         self._qcap = queue_capacity
         self._tcap = table_capacity
-        self._step = _build_step(self.tm, self._tprops, self._chunk)
+        self._max_sync_steps = sync_steps
+        self._loop = _build_loop(self.tm, self._tprops, self._chunk, self._qcap)
 
         # Host-side bookkeeping.
         self._unique = 0
         self._discovery_fps: Dict[str, int] = {}
-        self._spill: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._spill: List[np.ndarray] = []
 
         self._init_ebits_tensor = 0
         e = 0
@@ -182,20 +402,22 @@ class TpuBfsChecker(HostEngineBase):
     # -- engine body --------------------------------------------------------
 
     def _run(self) -> None:
-        import jax
         import jax.numpy as jnp
 
         from ..fingerprint import hash_words_np
-        from ..ops import frontier as fr
         from ..ops import visited_set as vs
 
         tm = self.tm
         S = tm.state_width
         A = tm.max_actions
         C = self._chunk
+        P = len(self._tprops)
+        W = S + 4  # queue lanes: state | h1 | h2 | ebits | depth
 
+        _dbg("run: encoding inits")
         inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
-        inb = np.asarray(tm.within_boundary_batch(np, inits), dtype=bool)
+        init_lanes = tuple(inits[:, i] for i in range(S))
+        inb = np.asarray(tm.within_boundary_lanes(np, init_lanes), dtype=bool)
         inits = inits[inb]
         n_init = len(inits)
         self._state_count = n_init
@@ -205,23 +427,44 @@ class TpuBfsChecker(HostEngineBase):
             raise ValueError("more initial states than queue capacity")
 
         # Seed the table with init fingerprints (parent sentinel (0,0)).
-        table = vs.empty_table(self._tcap)
+        # The claim protocol in vs.insert resolves duplicate init states.
+        # All init data crosses to the device in ONE upload (each individual
+        # transfer costs a ~100ms round-trip on a remote-attached device).
         h1, h2 = hash_words_np(inits)
-        zero = jnp.zeros(n_init, dtype=jnp.uint32)
-        keep = fr.dedup_mask(jnp.asarray(h1), jnp.asarray(h2), jnp.ones(n_init, bool))
-        table, is_new, unresolved = vs.insert(
-            table, jnp.asarray(h1), jnp.asarray(h2), zero, zero, keep
-        )
-        assert int(unresolved.sum()) == 0
-        self._unique = int(is_new.sum())
+        qinit = np.zeros((W, n_init), dtype=np.uint32)
+        qinit[:S] = inits.T
+        qinit[S] = h1
+        qinit[S + 1] = h2
+        qinit[S + 2] = self._init_ebits_tensor
+        qinit[S + 3] = 1
+        qinit_dev = jnp.asarray(qinit)  # the one upload
 
-        # Queue: all init rows (dups included, reference bfs.rs:76-82).
-        queue = jnp.zeros((self._qcap, S), dtype=jnp.uint32)
-        queue = queue.at[:n_init].set(jnp.asarray(inits))
-        q_ebits = jnp.full(
-            self._qcap, self._init_ebits_tensor, dtype=jnp.uint32
+        _dbg("run: seeding table")
+        table = vs.empty_table(self._tcap)
+        zero = jnp.zeros(n_init, dtype=jnp.uint32)
+        table, is_new, unresolved, _ovf = vs.insert_jit(
+            table,
+            qinit_dev[S],
+            qinit_dev[S + 1],
+            zero,
+            zero,
+            jnp.ones(n_init, bool),
         )
-        q_depth = jnp.ones(self._qcap, dtype=jnp.uint32)
+        stats = np.asarray(
+            jnp.stack(
+                [is_new.sum(dtype=jnp.uint32), unresolved.sum(dtype=jnp.uint32)]
+            )
+        )  # one download
+        assert int(stats[1]) == 0
+        self._unique = int(stats[0])
+
+        # Queue lanes: [state lanes | h1 | h2 | ebits | depth]. All init rows
+        # are enqueued, dups included (reference bfs.rs:76-82).
+        queue = tuple(
+            jnp.zeros(self._qcap, dtype=jnp.uint32).at[:n_init].set(qinit_dev[i])
+            for i in range(W)
+        )
+        _dbg("run: seeded; entering block loop")
         head = 0
         count = n_init
 
@@ -232,88 +475,147 @@ class TpuBfsChecker(HostEngineBase):
         )
         high_water = self._qcap - C * A
 
+        rec_bits = 0
+        rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
+        rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
+
+        # Progressive block sizing: gated no-op iterations still pay the
+        # width-proportional sort/compaction (~15ms each), so blocks start
+        # short and double while the search keeps saturating them — big runs
+        # converge to the full budget, small runs never overpay.
+        sync_steps = 4
+        max_sync = (
+            self._max_sync_steps
+            if self._timeout is None
+            else min(64, self._max_sync_steps)
+        )
+        # Packed-params passthrough: when the host changed nothing since the
+        # last block, the loop's own output params feed straight back in —
+        # zero uploads (each individual transfer costs a ~100ms round-trip
+        # on a remote-attached device).
+        params_dev = None
+        last_max_steps = None
+
         while count > 0 or self._spill:
+            host_dirty = params_dev is None
             # Refill from host spill, leaving room for the worst-case append
-            # (count must stay <= high_water going into the step, or the ring
+            # (count must stay <= high_water going into the loop, or the ring
             # append could wrap over unconsumed frontier rows).
-            while self._spill and count + len(self._spill[-1][0]) <= high_water:
-                rows, ebs, dps = self._spill.pop()
+            while self._spill and count + len(self._spill[-1]) <= high_water:
+                rows = self._spill.pop()
                 k = len(rows)
-                tail_idx = (head + count + np.arange(k)) & (self._qcap - 1)
-                queue = queue.at[jnp.asarray(tail_idx)].set(jnp.asarray(rows))
-                q_ebits = q_ebits.at[jnp.asarray(tail_idx)].set(jnp.asarray(ebs))
-                q_depth = q_depth.at[jnp.asarray(tail_idx)].set(jnp.asarray(dps))
+                tail_idx = jnp.asarray(
+                    (head + count + np.arange(k)) & (self._qcap - 1)
+                )
+                queue = tuple(
+                    queue[i].at[tail_idx].set(jnp.asarray(rows[:, i]))
+                    for i in range(W)
+                )
                 count += k
+                host_dirty = True
             if count == 0:
                 break
 
             # Proactive growth: guarantee the worst-case insert batch keeps
-            # the load factor <= ~0.5, so probe budgets can't be exhausted
-            # (exhaustion would silently drop states).
-            while self._unique + C * A > 0.45 * self._tcap:
+            # the load factor under vs.MAX_LOAD, so probe budgets can't be
+            # exhausted (exhaustion would silently drop states).
+            rcap = max(64 * A, (C * A) // 8)
+            while self._unique + rcap > vs.MAX_LOAD * self._tcap:
                 table, self._tcap = self._grow_table(table)
+                host_dirty = True
+            grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - rcap)
 
-            (
-                table,
-                queue,
-                q_ebits,
-                q_depth,
-                generated,
-                new_count,
-                unresolved,
-                max_depth_seen,
-                prop_found,
-                prop_fp1,
-                prop_fp2,
-            ) = self._step(
-                table,
-                queue,
-                q_ebits,
-                q_depth,
-                jnp.uint32(head),
-                jnp.uint32(count),
-                jnp.uint32(depth_limit),
+            max_steps = sync_steps
+            if self._target_state_count is not None:
+                # Bound overshoot past the state-count target: each step
+                # generates at most C*A states.
+                remaining = max(0, self._target_state_count - self._state_count)
+                max_steps = max(1, min(max_steps, 1 + remaining // max(1, C * A)))
+            if max_steps != last_max_steps:
+                host_dirty = True
+
+            if host_dirty:
+                params_in = jnp.asarray(
+                    np.array(
+                        [
+                            head,
+                            count,
+                            self._unique,
+                            rec_bits,
+                            depth_limit,
+                            grow_limit,
+                            high_water,
+                            max_steps,
+                            0,
+                            0,
+                            0,
+                            0,
+                        ],
+                        dtype=np.uint32,
+                    )
+                )
+            else:
+                params_in = params_dev
+            last_max_steps = max_steps
+
+            _t0 = time.monotonic()
+            table, queue, rec_fp1, rec_fp2, params_dev = self._loop(
+                table, queue, rec_fp1, rec_fp2, params_in
+            )
+            _t1 = time.monotonic()
+            vals = np.asarray(params_dev)  # the ONE download per block
+            _dbg(
+                f"block dirty={host_dirty} max_steps={max_steps} "
+                f"dispatch={_t1 - _t0:.3f}s read={time.monotonic() - _t1:.3f}s "
+                f"steps={vals[10]} gen={vals[8]} count={vals[1]} "
+                f"unique={vals[2]} rec={vals[3]:b}"
             )
 
-            processed = min(count, C)
-            generated = int(generated)
-            new_count = int(new_count)
-            if int(unresolved) != 0:
+            if int(vals[11]):
                 # Cannot happen with the proactive growth above short of a
                 # pathological probe sequence; losing states would be an
                 # unsound "verified", so fail loudly.
                 raise RuntimeError(
                     "visited-table probe budget exhausted despite headroom"
                 )
-            head = (head + processed) & (self._qcap - 1)
-            count = count - processed + new_count
-            self._state_count += generated
-            self._unique += new_count
-            self._max_depth = max(self._max_depth, int(max_depth_seen))
-
+            head = int(vals[0])
+            count = int(vals[1])
+            self._unique = int(vals[2])
+            self._state_count += int(vals[8])
+            self._max_depth = max(self._max_depth, int(vals[9]))
+            if int(vals[10]) >= max_steps:
+                sync_steps = min(sync_steps * 2, max_sync)
             # Record first discovery per property (reference races are
-            # benign; ours are deterministic).
-            if len(self._tprops):
-                found = np.asarray(prop_found)
-                fp1 = np.asarray(prop_fp1)
-                fp2 = np.asarray(prop_fp2)
+            # benign; ours are deterministic per compiled program).
+            new_bits = int(vals[3])
+            if new_bits != rec_bits:
+                fp1 = np.asarray(rec_fp1)
+                fp2 = np.asarray(rec_fp2)
                 for i, p in enumerate(self._tprops):
-                    if found[i] and p.name not in self._discovery_fps:
+                    if (new_bits >> i) & 1 and p.name not in self._discovery_fps:
                         self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
+                rec_bits = new_bits
 
             # Spill if the next chunk could overflow the ring.
             while count > high_water:
                 k = min(C * A, count - high_water)
-                take_idx = (head + count - k + np.arange(k)) & (self._qcap - 1)
-                idxs = jnp.asarray(take_idx)
-                self._spill.append(
-                    (
-                        np.asarray(queue[idxs]),
-                        np.asarray(q_ebits[idxs]),
-                        np.asarray(q_depth[idxs]),
-                    )
+                take_idx = jnp.asarray(
+                    (head + count - k + np.arange(k)) & (self._qcap - 1)
                 )
+                block = np.stack(
+                    [np.asarray(queue[i][take_idx]) for i in range(W)], axis=1
+                )
+                self._spill.append(block)
                 count -= k
+                # Refills can place these rows after deeper children, breaking
+                # the ring's depth monotonicity that the block-level maxd read
+                # relies on — fold their depth in here. (Counts rows that are
+                # guaranteed to be visited unless the run stops early; a rare
+                # slight over-report beats a systematic under-report.)
+                self._max_depth = max(
+                    self._max_depth, int(block[:, S + 3].max())
+                )
+                params_dev = None  # host-side count changed; force re-upload
 
             if self._finish_matched(self._discovery_fps):
                 break
@@ -325,33 +627,18 @@ class TpuBfsChecker(HostEngineBase):
             if self._timed_out():
                 break
 
-        self._table = np.asarray(table)  # retained for path reconstruction
+        # Retained (on device) for path reconstruction; downloaded lazily.
+        self._table_dev = table
         return
 
     def _grow_table(self, table):
-        """Double capacity and rehash every occupied row, chunked."""
-        import jax.numpy as jnp
-
+        """Double capacity and rehash on device (no table round-trips)."""
         from ..ops import visited_set as vs
 
-        old = np.asarray(table)
-        rows = old[np.asarray(vs.occupied_rows(old))]
         new_cap = self._tcap * 2
-        new_table = vs.empty_table(new_cap)
-        B = 1 << 16
-        for i in range(0, len(rows), B):
-            blk = rows[i : i + B]
-            n = len(blk)
-            new_table, _is_new, unres = vs.insert(
-                new_table,
-                jnp.asarray(blk[:, 0]),
-                jnp.asarray(blk[:, 1]),
-                jnp.asarray(blk[:, 2]),
-                jnp.asarray(blk[:, 3]),
-                jnp.ones(n, dtype=bool),
-            )
-            if int(unres.sum()) != 0:
-                raise RuntimeError("rehash failed; table pathologically full")
+        new_table, n_unresolved = vs.rehash_jit(table, vs.empty_table(new_cap))
+        if int(n_unresolved) != 0:
+            raise RuntimeError("rehash failed; table pathologically full")
         return new_table, new_cap
 
     # -- accessors ----------------------------------------------------------
@@ -367,28 +654,24 @@ class TpuBfsChecker(HostEngineBase):
         }
 
     def _reconstruct(self, fp64: int) -> Path:
-        """Walk device-table parent pointers, then re-execute the model
-        along the fingerprint chain (reference bfs.rs:380-409)."""
-        import jax.numpy as jnp
-
+        """Walk table parent pointers, then re-execute the model along the
+        fingerprint chain (reference bfs.rs:380-409). The table is downloaded
+        once; chains are walked in numpy (per-node device lookups would cost
+        a host round-trip each)."""
         from ..ops import visited_set as vs
 
-        table = jnp.asarray(self._table)
+        if not hasattr(self, "_table_np"):
+            self._table_np = tuple(np.asarray(l) for l in self._table_dev)
         chain = [fp64]
         cur = fp64
         for _ in range(10_000_000):
             h1, h2 = split64(cur)
-            found, p1, p2 = vs.lookup_parent(
-                table,
-                jnp.asarray([h1], dtype=jnp.uint32),
-                jnp.asarray([h2], dtype=jnp.uint32),
-            )
-            if not bool(found[0]):
+            found, p1, p2 = vs.lookup_parent_np(self._table_np, h1, h2)
+            if not found:
                 raise RuntimeError(
                     f"fingerprint {cur} missing from visited table during "
                     "path reconstruction"
                 )
-            p1, p2 = int(p1[0]), int(p2[0])
             if p1 == 0 and p2 == 0:
                 break
             cur = combine64(p1, p2)

@@ -210,6 +210,54 @@ def hash_words_jnp(words):
     return h1, h2
 
 
+def _hash_lanes_generic(xp, lanes, seed):
+    """Same mix as `_hash_words_generic`, but over a sequence of 1-D lane
+    arrays (structure-of-arrays layout) instead of the trailing axis of one
+    2-D array. lanes[i][n] == words[n, i] implies identical hashes — the two
+    layouts are interchangeable bit-for-bit.
+
+    The SoA form is the TPU-native one: each lane is a dense [N] vector, so
+    the mix is pure elementwise VPU work with no strided minor-axis reads
+    (a [N, S] row layout with small S wastes the 8x128 vector tiles).
+    """
+    S = len(lanes)
+    acc = xp.zeros(lanes[0].shape, dtype=xp.uint32)
+    acc = acc + xp.uint32(seed) + xp.uint32(_PRIME5) + xp.uint32(S * 4)
+    for w in lanes:
+        acc = acc + w * xp.uint32(_PRIME3)
+        acc = (acc << xp.uint32(17)) | (acc >> xp.uint32(15))
+        acc = acc * xp.uint32(_PRIME4)
+    acc = acc ^ (acc >> xp.uint32(15))
+    acc = acc * xp.uint32(_PRIME2)
+    acc = acc ^ (acc >> xp.uint32(13))
+    acc = acc * xp.uint32(_PRIME3)
+    acc = acc ^ (acc >> xp.uint32(16))
+    return acc
+
+
+def hash_lanes_np(lanes) -> tuple[np.ndarray, np.ndarray]:
+    """SoA twin of `hash_words_np`: hash a sequence of uint32 lane arrays."""
+    lanes = [np.asarray(l, dtype=np.uint32) for l in lanes]
+    with np.errstate(over="ignore"):
+        h1 = _hash_lanes_generic(np, lanes, SEED1)
+        h2 = _hash_lanes_generic(np, lanes, SEED2)
+    both_zero = (h1 == 0) & (h2 == 0)
+    h2 = np.where(both_zero, np.uint32(1), h2)
+    return h1, h2
+
+
+def hash_lanes_jnp(lanes):
+    """JAX twin of `hash_lanes_np`."""
+    import jax.numpy as jnp
+
+    lanes = [l.astype(jnp.uint32) for l in lanes]
+    h1 = _hash_lanes_generic(jnp, lanes, int(SEED1))
+    h2 = _hash_lanes_generic(jnp, lanes, int(SEED2))
+    both_zero = (h1 == 0) & (h2 == 0)
+    h2 = jnp.where(both_zero, jnp.uint32(1), h2)
+    return h1, h2
+
+
 def combine64(h1, h2) -> int:
     """Combine a (h1, h2) uint32 pair into the canonical 64-bit fingerprint int."""
     return (int(h1) << 32) | int(h2)

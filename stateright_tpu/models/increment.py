@@ -83,42 +83,42 @@ class IncrementTensor(TensorModel):
             row[2 + 2 * k] = 1  # pc = 1
         return row[None, :]
 
-    def step_batch(self, xp, states):
+    def step_lanes(self, xp, lanes):
         u = xp.uint32
         succs = []
         masks = []
-        shared = states[:, 0]
+        shared = lanes[0]
         for k in range(self.n):
-            t = states[:, 1 + 2 * k]
-            pc = states[:, 2 + 2 * k]
+            t = lanes[1 + 2 * k]
+            pc = lanes[2 + 2 * k]
 
             # Read(k): t <- shared, pc <- 2
-            cols = [states[:, j] for j in range(self.state_width)]
+            cols = list(lanes)
             cols[1 + 2 * k] = shared
             cols[2 + 2 * k] = xp.full_like(pc, 2)
-            succs.append(xp.stack(cols, axis=-1))
+            succs.append(tuple(cols))
             masks.append(pc == u(1))
 
             # Write(k): shared <- t + 1, pc <- 3
-            cols = [states[:, j] for j in range(self.state_width)]
+            cols = list(lanes)
             cols[0] = (t + u(1)) & u(0xFF)
             cols[2 + 2 * k] = xp.full_like(pc, 3)
-            succs.append(xp.stack(cols, axis=-1))
+            succs.append(tuple(cols))
             masks.append(pc == u(2))
 
-        return xp.stack(succs, axis=1), xp.stack(masks, axis=1)
+        return succs, masks
 
     def tensor_properties(self) -> List[TensorProperty]:
         n = self.n
 
-        def fin(xp, states):
-            finished = states[:, 2] == xp.uint32(3)
+        def fin(xp, lanes):
+            finished = lanes[2] == xp.uint32(3)
             count = finished.astype(xp.uint32)
             for k in range(1, n):
-                count = count + (states[:, 2 + 2 * k] == xp.uint32(3)).astype(
+                count = count + (lanes[2 + 2 * k] == xp.uint32(3)).astype(
                     xp.uint32
                 )
-            return (count & xp.uint32(0xFF)) == states[:, 0]
+            return (count & xp.uint32(0xFF)) == lanes[0]
 
         return [TensorProperty.always("fin", fin)]
 

@@ -93,61 +93,61 @@ class IncrementLockTensor(TensorModel):
     def init_states_array(self) -> np.ndarray:
         return np.zeros((1, self.state_width), dtype=np.uint32)
 
-    def step_batch(self, xp, states):
+    def step_lanes(self, xp, lanes):
         u = xp.uint32
         succs = []
         masks = []
-        shared = states[:, 0]
-        lock = states[:, 1]
+        shared = lanes[0]
+        lock = lanes[1]
         for k in range(self.n):
-            t = states[:, 2 + 2 * k]
-            pc = states[:, 3 + 2 * k]
+            t = lanes[2 + 2 * k]
+            pc = lanes[3 + 2 * k]
 
             # Lock(k): lock <- 1, pc <- 1 (enabled iff pc == 0 and !lock)
-            cols = [states[:, j] for j in range(self.state_width)]
+            cols = list(lanes)
             cols[1] = xp.ones_like(lock)
             cols[3 + 2 * k] = xp.full_like(pc, 1)
-            succs.append(xp.stack(cols, axis=-1))
+            succs.append(tuple(cols))
             masks.append((pc == u(0)) & (lock == u(0)))
 
             # Read(k): t <- shared, pc <- 2
-            cols = [states[:, j] for j in range(self.state_width)]
+            cols = list(lanes)
             cols[2 + 2 * k] = shared
             cols[3 + 2 * k] = xp.full_like(pc, 2)
-            succs.append(xp.stack(cols, axis=-1))
+            succs.append(tuple(cols))
             masks.append(pc == u(1))
 
             # Write(k): shared <- t + 1, pc <- 3
-            cols = [states[:, j] for j in range(self.state_width)]
+            cols = list(lanes)
             cols[0] = (t + u(1)) & u(0xFF)
             cols[3 + 2 * k] = xp.full_like(pc, 3)
-            succs.append(xp.stack(cols, axis=-1))
+            succs.append(tuple(cols))
             masks.append(pc == u(2))
 
             # Release(k): lock <- 0, pc <- 4
-            cols = [states[:, j] for j in range(self.state_width)]
+            cols = list(lanes)
             cols[1] = xp.zeros_like(lock)
             cols[3 + 2 * k] = xp.full_like(pc, 4)
-            succs.append(xp.stack(cols, axis=-1))
+            succs.append(tuple(cols))
             masks.append((pc == u(3)) & (lock == u(1)))
 
-        return xp.stack(succs, axis=1), xp.stack(masks, axis=1)
+        return succs, masks
 
     def tensor_properties(self) -> List[TensorProperty]:
         n = self.n
 
-        def fin(xp, states):
-            count = xp.zeros(states.shape[0], dtype=xp.uint32)
+        def fin(xp, lanes):
+            count = xp.zeros(lanes[0].shape, dtype=xp.uint32)
             for k in range(n):
-                count = count + (states[:, 3 + 2 * k] >= xp.uint32(3)).astype(
+                count = count + (lanes[3 + 2 * k] >= xp.uint32(3)).astype(
                     xp.uint32
                 )
-            return (count & xp.uint32(0xFF)) == states[:, 0]
+            return (count & xp.uint32(0xFF)) == lanes[0]
 
-        def mutex(xp, states):
-            count = xp.zeros(states.shape[0], dtype=xp.uint32)
+        def mutex(xp, lanes):
+            count = xp.zeros(lanes[0].shape, dtype=xp.uint32)
             for k in range(n):
-                pc = states[:, 3 + 2 * k]
+                pc = lanes[3 + 2 * k]
                 count = count + (
                     (pc >= xp.uint32(1)) & (pc < xp.uint32(4))
                 ).astype(xp.uint32)

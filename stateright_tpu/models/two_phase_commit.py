@@ -181,10 +181,10 @@ class TwoPhaseTensor(TensorModel):
     def _rm_state(xp, lane1, rm: int):
         return (lane1 >> xp.uint32(2 * rm)) & xp.uint32(3)
 
-    def step_batch(self, xp, states):
+    def step_lanes(self, xp, lanes):
         n = self.n
         u = xp.uint32
-        lane0, lane1, lane2 = states[:, 0], states[:, 1], states[:, 2]
+        lane0, lane1, lane2 = lanes
         tm = self._tm_state(xp, lane0)
         prep_mask = self._prepared_mask(xp, lane0)
         all_prepared = prep_mask == u((1 << n) - 1)
@@ -196,27 +196,23 @@ class TwoPhaseTensor(TensorModel):
         masks = []
 
         # slot 0: TmCommit
-        s0 = xp.stack(
-            [
+        succs.append(
+            (
                 (lane0 & ~u(3)) | u(TM_COMMITTED),
                 lane1,
                 lane2 | (u(1) << u(30)),
-            ],
-            axis=-1,
+            )
         )
-        succs.append(s0)
         masks.append(tm_init & all_prepared)
 
         # slot 1: TmAbort
-        s1 = xp.stack(
-            [
+        succs.append(
+            (
                 (lane0 & ~u(3)) | u(TM_ABORTED),
                 lane1,
                 lane2 | (u(1) << u(31)),
-            ],
-            axis=-1,
+            )
         )
-        succs.append(s1)
         masks.append(tm_init)
 
         for rm in range(n):
@@ -226,92 +222,76 @@ class TwoPhaseTensor(TensorModel):
             rm_clear = ~(u(3) << rm_shift)
 
             # TmRcvPrepared(rm)
-            succs.append(
-                xp.stack(
-                    [lane0 | (u(1) << u(2 + rm)), lane1, lane2], axis=-1
-                )
-            )
+            succs.append((lane0 | (u(1) << u(2 + rm)), lane1, lane2))
             masks.append(tm_init & prepared_msg)
 
             # RmPrepare(rm)
             succs.append(
-                xp.stack(
-                    [
-                        lane0,
-                        (lane1 & rm_clear) | (u(PREPARED) << rm_shift),
-                        lane2 | (u(1) << u(rm)),
-                    ],
-                    axis=-1,
+                (
+                    lane0,
+                    (lane1 & rm_clear) | (u(PREPARED) << rm_shift),
+                    lane2 | (u(1) << u(rm)),
                 )
             )
             masks.append(rm_working)
 
             # RmChooseToAbort(rm)
             succs.append(
-                xp.stack(
-                    [
-                        lane0,
-                        (lane1 & rm_clear) | (u(ABORTED) << rm_shift),
-                        lane2,
-                    ],
-                    axis=-1,
+                (
+                    lane0,
+                    (lane1 & rm_clear) | (u(ABORTED) << rm_shift),
+                    lane2,
                 )
             )
             masks.append(rm_working)
 
             # RmRcvCommitMsg(rm)
             succs.append(
-                xp.stack(
-                    [
-                        lane0,
-                        (lane1 & rm_clear) | (u(COMMITTED) << rm_shift),
-                        lane2,
-                    ],
-                    axis=-1,
+                (
+                    lane0,
+                    (lane1 & rm_clear) | (u(COMMITTED) << rm_shift),
+                    lane2,
                 )
             )
             masks.append(has_commit == u(1))
 
             # RmRcvAbortMsg(rm)
             succs.append(
-                xp.stack(
-                    [
-                        lane0,
-                        (lane1 & rm_clear) | (u(ABORTED) << rm_shift),
-                        lane2,
-                    ],
-                    axis=-1,
+                (
+                    lane0,
+                    (lane1 & rm_clear) | (u(ABORTED) << rm_shift),
+                    lane2,
                 )
             )
             masks.append(has_abort == u(1))
 
-        return xp.stack(succs, axis=1), xp.stack(masks, axis=1)
+        return succs, masks
 
     def tensor_properties(self) -> List[TensorProperty]:
         n = self.n
 
-        def rm_states(xp, states):
-            lane1 = states[:, 1]
+        def rm_states(xp, lanes):
+            lane1 = lanes[1]
             return [
                 (lane1 >> xp.uint32(2 * rm)) & xp.uint32(3) for rm in range(n)
             ]
 
-        def abort_agreement(xp, states):
-            rs = rm_states(xp, states)
+        def abort_agreement(xp, lanes):
+            rs = rm_states(xp, lanes)
             acc = rs[0] == xp.uint32(ABORTED)
             for r in rs[1:]:
                 acc = acc & (r == xp.uint32(ABORTED))
             return acc
 
-        def commit_agreement(xp, states):
-            rs = rm_states(xp, states)
+        def commit_agreement(xp, lanes):
+            rs = rm_states(xp, lanes)
             acc = rs[0] == xp.uint32(COMMITTED)
             for r in rs[1:]:
                 acc = acc & (r == xp.uint32(COMMITTED))
             return acc
 
-        def consistent(xp, states):
-            rs = rm_states(xp, states)
+        def consistent(xp, lanes):
+            rs = rm_states(xp, lanes)
             any_abort = rs[0] == xp.uint32(ABORTED)
             any_commit = rs[0] == xp.uint32(COMMITTED)
             for r in rs[1:]:

@@ -1,0 +1,11 @@
+"""Native (C++) runtime core for real-network actor execution.
+
+The event-loop core — sockets, deadline tracking, poll loop, datagram IO —
+lives in compiled code (`core.cpp`, built to `_core.so`); Python is called
+back only for protocol logic (the actor's `on_start`/`on_msg`/`on_timeout`/
+`on_random`) and message serialization. This mirrors the reference keeping
+its spawn runtime in compiled Rust (src/actor/spawn.rs:64-154).
+
+Build with `python -m stateright_tpu.native.build` (requires g++); the
+portable Python engine in `actor/spawn.py` is the fallback.
+"""

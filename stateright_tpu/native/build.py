@@ -1,0 +1,59 @@
+"""Build the native event-loop core: `python -m stateright_tpu.native.build`.
+
+Compiles core.cpp into _core.so next to this file with g++ (no pybind11 —
+the binding layer is ctypes in runtime.py).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_DIR, "core.cpp")
+OUTPUT = os.path.join(_DIR, "_core.so")
+
+
+def build(quiet: bool = False) -> bool:
+    """Compile the core; returns True on success."""
+    gxx = shutil.which("g++") or shutil.which("c++")
+    if gxx is None:
+        if not quiet:
+            print("native build: no C++ compiler found", file=sys.stderr)
+        return False
+    cmd = [
+        gxx,
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        OUTPUT,
+        SOURCE,
+        "-lpthread",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except Exception as e:
+        if not quiet:
+            print(f"native build failed to run: {e}", file=sys.stderr)
+        return False
+    if proc.returncode != 0:
+        if not quiet:
+            print(proc.stderr, file=sys.stderr)
+        return False
+    return True
+
+
+def is_built() -> bool:
+    return os.path.exists(OUTPUT) and os.path.getmtime(OUTPUT) >= os.path.getmtime(
+        SOURCE
+    )
+
+
+if __name__ == "__main__":
+    ok = build()
+    print(f"native core: {'built ' + OUTPUT if ok else 'BUILD FAILED'}")
+    sys.exit(0 if ok else 1)

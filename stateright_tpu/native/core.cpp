@@ -1,0 +1,212 @@
+// Native event-loop core for stateright_tpu's real-network actor runtime.
+//
+// Role parity: src/actor/spawn.rs:64-154 in the reference — one OS thread
+// per actor owning a UDP socket, a deadline map driving timer/random
+// interrupts (the socket wait is bounded by the earliest deadline), and
+// fire-and-forget datagram sends. Protocol logic stays in the host
+// language: every event is delivered through a single callback, and the
+// host issues commands back through the srn_* entry points (which are
+// safe to call from inside the callback — the mutex is not held across
+// callback invocations).
+//
+// C ABI only (loaded via ctypes; no pybind11 in this environment).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double now_s() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch())
+      .count();
+}
+
+// Event kinds delivered to the host callback.
+constexpr int32_t kEventStart = 0;
+constexpr int32_t kEventMsg = 1;
+constexpr int32_t kEventDeadline = 2;
+
+using srn_event_cb = void (*)(void* ctx, int32_t actor, int32_t kind,
+                              uint32_t src_ip, uint16_t src_port,
+                              const uint8_t* data, int64_t len, uint64_t key);
+
+struct ActorRt {
+  int fd = -1;
+  std::mutex mu;
+  std::map<uint64_t, double> deadlines;  // key -> absolute deadline (now_s)
+  std::thread th;
+};
+
+struct Runtime {
+  std::vector<std::unique_ptr<ActorRt>> actors;
+  std::atomic<bool> stop{false};
+  srn_event_cb cb = nullptr;
+  void* ctx = nullptr;
+};
+
+std::mutex g_mu;
+std::map<int64_t, Runtime*> g_runtimes;
+int64_t g_next_handle = 1;
+
+Runtime* lookup(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_runtimes.find(handle);
+  return it == g_runtimes.end() ? nullptr : it->second;
+}
+
+constexpr size_t kRecvBuf = 65535;  // reference buffer size (spawn.rs:82)
+constexpr int kStopPollMs = 50;     // stop-flag responsiveness bound
+
+void actor_loop(Runtime* rt, int32_t index) {
+  ActorRt& a = *rt->actors[index];
+  rt->cb(rt->ctx, index, kEventStart, 0, 0, nullptr, 0, 0);
+
+  std::vector<uint8_t> buf(kRecvBuf);
+  while (!rt->stop.load(std::memory_order_relaxed)) {
+    // Earliest pending deadline bounds the socket wait (spawn.rs:92-142).
+    bool have = false;
+    uint64_t due_key = 0;
+    double due = 0;
+    {
+      std::lock_guard<std::mutex> lk(a.mu);
+      for (const auto& kv : a.deadlines) {
+        if (!have || kv.second < due) {
+          have = true;
+          due_key = kv.first;
+          due = kv.second;
+        }
+      }
+    }
+    double now = now_s();
+    if (have && due <= now) {
+      {
+        std::lock_guard<std::mutex> lk(a.mu);
+        a.deadlines.erase(due_key);
+      }
+      rt->cb(rt->ctx, index, kEventDeadline, 0, 0, nullptr, 0, due_key);
+      continue;
+    }
+    int timeout_ms = kStopPollMs;
+    if (have) {
+      double wait = (due - now) * 1000.0;
+      if (wait < timeout_ms) timeout_ms = wait < 1 ? 1 : (int)wait;
+    }
+    struct pollfd pfd;
+    pfd.fd = a.fd;
+    pfd.events = POLLIN;
+    int rc = poll(&pfd, 1, timeout_ms);
+    if (rc <= 0 || !(pfd.revents & POLLIN)) continue;
+    sockaddr_in src{};
+    socklen_t srclen = sizeof(src);
+    ssize_t n = recvfrom(a.fd, buf.data(), buf.size(), 0,
+                         reinterpret_cast<sockaddr*>(&src), &srclen);
+    if (n <= 0) continue;
+    rt->cb(rt->ctx, index, kEventMsg, ntohl(src.sin_addr.s_addr),
+           ntohs(src.sin_port), buf.data(), n, 0);
+  }
+  close(a.fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts one thread+socket per actor. ips are host-order IPv4 addresses.
+// Returns a handle (> 0), or -1-errno_index on bind failure.
+int64_t srn_start(const uint32_t* ips, const uint16_t* ports, int32_t n,
+                  srn_event_cb cb, void* ctx) {
+  auto rt = std::make_unique<Runtime>();
+  rt->cb = cb;
+  rt->ctx = ctx;
+  for (int32_t i = 0; i < n; i++) {
+    auto a = std::make_unique<ActorRt>();
+    a->fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (a->fd < 0) return -1 - i;
+    int one = 1;
+    setsockopt(a->fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(ips[i]);
+    addr.sin_port = htons(ports[i]);
+    if (bind(a->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      close(a->fd);
+      return -1 - i;
+    }
+    rt->actors.push_back(std::move(a));
+  }
+  Runtime* raw = rt.release();
+  int64_t handle;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    handle = g_next_handle++;
+    g_runtimes[handle] = raw;
+  }
+  for (int32_t i = 0; i < n; i++) {
+    raw->actors[i]->th = std::thread(actor_loop, raw, i);
+  }
+  return handle;
+}
+
+void srn_send(int64_t handle, int32_t actor, uint32_t dst_ip,
+              uint16_t dst_port, const uint8_t* data, int64_t len) {
+  Runtime* rt = lookup(handle);
+  if (!rt || actor < 0 || (size_t)actor >= rt->actors.size()) return;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(dst_ip);
+  addr.sin_port = htons(dst_port);
+  // Fire-and-forget (spawn.rs:188-196): errors intentionally ignored.
+  sendto(rt->actors[actor]->fd, data, (size_t)len, 0,
+         reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+}
+
+void srn_set_deadline(int64_t handle, int32_t actor, uint64_t key,
+                      double delay_s) {
+  Runtime* rt = lookup(handle);
+  if (!rt || actor < 0 || (size_t)actor >= rt->actors.size()) return;
+  ActorRt& a = *rt->actors[actor];
+  std::lock_guard<std::mutex> lk(a.mu);
+  a.deadlines[key] = now_s() + delay_s;
+}
+
+void srn_cancel_deadline(int64_t handle, int32_t actor, uint64_t key) {
+  Runtime* rt = lookup(handle);
+  if (!rt || actor < 0 || (size_t)actor >= rt->actors.size()) return;
+  ActorRt& a = *rt->actors[actor];
+  std::lock_guard<std::mutex> lk(a.mu);
+  a.deadlines.erase(key);
+}
+
+// Stops all actor threads and frees the runtime.
+void srn_stop(int64_t handle) {
+  Runtime* rt = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_runtimes.find(handle);
+    if (it == g_runtimes.end()) return;
+    rt = it->second;
+    g_runtimes.erase(it);
+  }
+  rt->stop.store(true);
+  for (auto& a : rt->actors) {
+    if (a->th.joinable()) a->th.join();
+  }
+  delete rt;
+}
+
+}  // extern "C"

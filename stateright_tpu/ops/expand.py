@@ -6,6 +6,20 @@ reference checker's hot loop (src/checker/bfs.rs:196-334); they share this
 builder so the semantics live in exactly one place. The engines differ only
 in what happens *after* expansion: the single-device engine inserts locally,
 the sharded engine first exchanges candidates across the mesh.
+
+Everything is structure-of-arrays: states are tuples of dense [C] uint32
+lane arrays, and the C*A candidate batch is laid out ACTION-MAJOR
+(index = a*C + c) so it is built with cheap concatenations of per-action
+lanes — never a [C, A, S] materialization, whose small minor axes would
+waste the TPU's 8x128 vector tiles.
+
+Property verdicts are returned as RAW PER-ROW HIT MASKS (`prop_hits`), not
+as extracted fingerprints: on the target platform, a loop-carried value
+computed through a reduction -> broadcast -> reduction chain (argmax
+selects, one-hot extractions, max reduces) knocks the whole loop off the
+fast dispatch path (~200ms per iteration, measured). Callers carry the
+masks (or mask snapshots) through their loops with pure elementwise ops
+and extract fingerprints once per block, outside the loop.
 """
 
 from __future__ import annotations
@@ -17,7 +31,7 @@ from ..core import Expectation
 
 class Expanded(NamedTuple):
     ebits: object  # [C] uint32, post property evaluation
-    flat: object  # [C*A, S] candidate states
+    flat: object  # tuple of S lane arrays, each [C*A] (action-major)
     h1: object  # [C*A] candidate fingerprints
     h2: object
     parent1: object  # [C*A] parent fingerprints
@@ -26,38 +40,38 @@ class Expanded(NamedTuple):
     child_depth: object  # [C*A]
     valid: object  # [C*A] bool: action valid & in boundary & parent live
     generated: object  # scalar uint32: number of valid candidates
-    max_depth_seen: object  # scalar uint32
-    prop_found: object  # [P] bool
-    prop_fp1: object  # [P] uint32
-    prop_fp2: object  # [P] uint32
+    prop_hits: object  # list of P [C] bool masks (see module docstring)
 
 
 def build_eval_and_expand(tm, props, chunk: int):
-    """Returns f(rows, ebits, depth, active, depth_limit) -> Expanded.
+    """Returns f(rows, row_h1, row_h2, ebits, depth, active, depth_limit)
+    -> Expanded, where `rows` is a tuple of S [C] lane arrays.
+
+    `row_h1`/`row_h2` are the popped rows' fingerprint halves, computed when
+    the rows were first enqueued (the frontier ring carries them), so popped
+    states are never re-hashed.
 
     Implements, batched: property evaluation with eventually-bit clearing
     (bfs.rs:231-277), depth limiting (bfs.rs:219-224), successor generation
     with boundary filtering, the terminal rule (no successor passed the
     boundary, dups included — bfs.rs:283-333), and terminal eventually-bit
-    discoveries (bfs.rs:326-333).
+    discoveries (bfs.rs:326-333). `prop_hits[i]` marks the rows whose visit
+    discovers property i: a violated always / satisfied sometimes condition,
+    or a terminal state with property i's eventually-bit still pending.
     """
     import jax.numpy as jnp
 
-    from ..fingerprint import hash_words_jnp
+    from ..fingerprint import hash_lanes_jnp
 
     S = tm.state_width
     A = tm.max_actions
 
-    def eval_and_expand(rows, ebits, depth, active, depth_limit):
+    def eval_and_expand(rows, row_h1, row_h2, ebits, depth, active, depth_limit):
         u = jnp.uint32
-        max_depth_seen = jnp.max(jnp.where(active, depth, u(0)))
         # Depth-limited rows are popped but neither evaluated nor expanded.
         live = active & (depth < depth_limit)
-        row_h1, row_h2 = hash_words_jnp(rows)
 
-        prop_found = []
-        prop_fp1 = []
-        prop_fp2 = []
+        prop_hits = []
         e_idx = 0
         e_slot = {}
         for i, p in enumerate(props):
@@ -66,58 +80,47 @@ def build_eval_and_expand(tm, props, chunk: int):
                 ebits = jnp.where(vals, ebits & ~u(1 << e_idx), ebits)
                 e_slot[i] = e_idx
                 e_idx += 1
-                prop_found.append(None)  # filled in after terminal rule
-                prop_fp1.append(None)
-                prop_fp2.append(None)
+                prop_hits.append(None)  # filled in after terminal rule
                 continue
             if p.expectation == Expectation.ALWAYS:
-                hits = live & ~p.check(jnp, rows)
+                prop_hits.append(live & ~p.check(jnp, rows))
             else:  # SOMETIMES
-                hits = live & p.check(jnp, rows)
-            sel = jnp.argmax(hits)
-            prop_found.append(jnp.any(hits))
-            prop_fp1.append(row_h1[sel])
-            prop_fp2.append(row_h2[sel])
+                prop_hits.append(live & p.check(jnp, rows))
 
-        succs, amask = tm.step_batch(jnp, rows)  # [C, A, S], [C, A]
-        amask = amask & live[:, None]
-        flat = succs.reshape(chunk * A, S)
-        inb = tm.within_boundary_batch(jnp, flat).reshape(chunk, A)
-        valid = amask & inb
-        generated = valid.sum(dtype=jnp.uint32)
+        # succs: list over A of S-lane tuples; masks: list over A of [C] bool
+        succs, amask = tm.step_lanes(jnp, rows)
+        valid_per_a = []
+        any_valid = None
+        for a in range(A):
+            v = amask[a] & live & tm.within_boundary_lanes(jnp, succs[a])
+            valid_per_a.append(v)
+            any_valid = v if any_valid is None else (any_valid | v)
+        valid = jnp.concatenate(valid_per_a)  # [A*C], action-major
+        generated = valid.sum(dtype=u)
 
-        terminal = live & ~jnp.any(valid, axis=1)
+        terminal = live & ~any_valid
         for i, p in enumerate(props):
             if p.expectation != Expectation.EVENTUALLY:
                 continue
             bit = u(1 << e_slot[i])
-            fails = terminal & ((ebits & bit) != 0)
-            sel = jnp.argmax(fails)
-            prop_found[i] = jnp.any(fails)
-            prop_fp1[i] = row_h1[sel]
-            prop_fp2[i] = row_h2[sel]
+            prop_hits[i] = terminal & ((ebits & bit) != 0)
 
-        h1, h2 = hash_words_jnp(flat)
-        n_props = len(props)
+        flat = tuple(
+            jnp.concatenate([succs[a][s] for a in range(A)]) for s in range(S)
+        )
+        h1, h2 = hash_lanes_jnp(flat)
         return Expanded(
             ebits=ebits,
             flat=flat,
             h1=h1,
             h2=h2,
-            parent1=jnp.repeat(row_h1, A),
-            parent2=jnp.repeat(row_h2, A),
-            child_ebits=jnp.repeat(ebits, A),
-            child_depth=jnp.repeat(depth + u(1), A),
-            valid=valid.reshape(chunk * A),
+            parent1=jnp.tile(row_h1, A),
+            parent2=jnp.tile(row_h2, A),
+            child_ebits=jnp.tile(ebits, A),
+            child_depth=jnp.tile(depth + u(1), A),
+            valid=valid,
             generated=generated,
-            max_depth_seen=max_depth_seen,
-            prop_found=jnp.stack(prop_found) if n_props else jnp.zeros(0, bool),
-            prop_fp1=(
-                jnp.stack(prop_fp1) if n_props else jnp.zeros(0, jnp.uint32)
-            ),
-            prop_fp2=(
-                jnp.stack(prop_fp2) if n_props else jnp.zeros(0, jnp.uint32)
-            ),
+            prop_hits=prop_hits,
         )
 
     return eval_and_expand

@@ -1,11 +1,15 @@
-"""Frontier array programs: in-batch dedup, masked compaction, ring queue.
+"""Frontier array programs: masked ring-queue append/pop, in-batch dedup.
 
 These are the TPU-shaped replacements for the reference's per-thread
 VecDeque pending queues and entry-API dedup (src/checker/bfs.rs:177-335):
 ragged per-state successor sets become fixed-shape candidate batches that
-are deduplicated by sort, filtered by a visited-set insert, compacted by
-stable argsort, and appended to a power-of-two ring buffer that lives in
-device memory.
+are filtered by a claim-arbitrated visited-set insert and appended to a
+power-of-two ring buffer that lives in device memory.
+
+The ring is structure-of-arrays: a tuple of dense [qcap] uint32 lane
+arrays. Gathers and scatters touch each lane as a flat 1-D vector — the
+layout TPU tiling is fast at — and the ring index math is computed once
+and shared across lanes.
 """
 
 from __future__ import annotations
@@ -20,6 +24,10 @@ def dedup_mask(h1, h2, valid):
     rows to the end; equal valid neighbors are duplicates. Which duplicate
     survives is arbitrary-but-deterministic, matching the reference's
     benign insert races (bfs.rs:243-244, 302-315).
+
+    Note: the visited-set insert no longer requires pre-deduplication (its
+    claim protocol arbitrates in-batch duplicates); this remains for hosts
+    of sorted-exchange schemes and tests.
     """
     invalid = (~valid).astype(jnp.uint8)
     perm = jnp.lexsort((h2, h1, invalid))  # last key is primary
@@ -31,33 +39,35 @@ def dedup_mask(h1, h2, valid):
     return jnp.zeros(h1.shape[0], dtype=bool).at[perm].set(first & sv)
 
 
-def compact_indices(keep):
-    """Stable indices of kept rows, packed to the front; count of kept.
+def ring_indices(head, n, cap):
+    """[n] ring positions starting at `head` in a power-of-two ring."""
+    return (head + jnp.arange(n, dtype=jnp.uint32)) & jnp.uint32(cap - 1)
 
-    Returns (indices[N], count) where indices[:count] are the positions of
-    kept rows in order and the tail repeats the last kept index (callers
-    mask by count).
+
+def ring_gather(lanes, head, n):
+    """Pop-view `n` consecutive ring rows: returns (lane tuples, indices)."""
+    cap = lanes[0].shape[0]
+    idx = ring_indices(head, n, cap)
+    return tuple(l[idx] for l in lanes), idx
+
+
+def ring_scatter(lanes, tail, cand_lanes, valid):
+    """Append candidate rows where `valid`, packed at tail..tail+count.
+
+    Valid rows land at consecutive ring positions in candidate order
+    (cumsum compaction); invalid rows scatter out of bounds and drop. The
+    target positions are unique, which keeps the scatters on the fast
+    TPU path.
     """
-    order = jnp.argsort(~keep, stable=True)
-    count = keep.sum(dtype=jnp.uint32)
-    return order, count
-
-
-def ring_gather(queue, head, n):
-    """Gather `n` rows starting at `head` from a power-of-two ring buffer."""
-    cap = queue.shape[0]
-    idx = (head + jnp.arange(n, dtype=jnp.uint32)) & jnp.uint32(cap - 1)
-    return queue[idx], idx
-
-
-def ring_scatter(queue, tail, rows, valid):
-    """Append rows where `valid` at positions tail..tail+count in ring order.
-
-    `rows` must already be compacted (valid rows first); returns the updated
-    queue. Invalid rows scatter out of bounds and are dropped.
-    """
-    cap = queue.shape[0]
+    cap = lanes[0].shape[0]
+    n = valid.shape[0]
     offsets = jnp.cumsum(valid.astype(jnp.uint32)) - 1
     idx = (tail + offsets) & jnp.uint32(cap - 1)
-    idx = jnp.where(valid, idx, cap)
-    return queue.at[idx].set(rows, mode="drop")
+    # Dropped rows get DISTINCT out-of-bounds indices so the unique_indices
+    # promise holds even for the discarded entries.
+    oob = jnp.uint32(cap) + jnp.arange(n, dtype=jnp.uint32)
+    idx = jnp.where(valid, idx, oob)
+    return tuple(
+        l.at[idx].set(c, mode="drop", unique_indices=True)
+        for l, c in zip(lanes, cand_lanes)
+    )

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..core import Expectation
-from ..fingerprint import combine64, hash_words_np
+from ..fingerprint import combine64, hash_words_jnp, hash_words_np
 from ..tensor import TensorModel
 
 
@@ -46,38 +46,55 @@ def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis:
     from ..ops import visited_set as vs
     from ..ops.expand import build_eval_and_expand
 
-    A = tm.max_actions
+    S = tm.state_width
     eval_and_expand = build_eval_and_expand(tm, props, chunk)
 
-    def per_device(table, queue, q_ebits, q_depth, head, count, depth_limit):
+    def per_device(table, queue, head, count, depth_limit):
         # Local blocks arrive with a leading length-1 shard dim; drop it.
-        table = table[0]
-        queue = queue[0]
-        q_ebits = q_ebits[0]
-        q_depth = q_depth[0]
+        # `table` is the 4-lane visited tuple, `queue` the W-lane ring tuple
+        # (structure-of-arrays; see ops/visited_set.py for why).
+        table = tuple(t[0] for t in table)
+        queue = tuple(q[0] for q in queue)
         head = head[0]
         count = count[0]
         depth_limit = depth_limit[0]
 
         u = jnp.uint32
         me = lax.axis_index(axis).astype(jnp.uint32)
-        qcap = queue.shape[0]
+        qcap = queue[0].shape[0]
         qmask = u(qcap - 1)
         take = jnp.minimum(count, u(chunk))
         active = jnp.arange(chunk, dtype=jnp.uint32) < take
-        rows, slots = fr.ring_gather(queue, head, chunk)
-        ebits = q_ebits[slots]
-        depth = q_depth[slots]
+        popped, _slots = fr.ring_gather(queue, head, chunk)
+        rows = popped[:S]
+        row_h1 = popped[S]
+        row_h2 = popped[S + 1]
+        ebits = popped[S + 2]
+        depth = popped[S + 3]
 
-        ex = eval_and_expand(rows, ebits, depth, active, depth_limit)
+        ex = eval_and_expand(
+            rows, row_h1, row_h2, ebits, depth, active, depth_limit
+        )
         generated = ex.generated
-        max_depth_seen = ex.max_depth_seen
+        max_depth_seen = jnp.max(jnp.where(active, depth, u(0)))
+        # Discovery extraction per step is fine here: this program runs once
+        # per host call (no device loop), so argmax/max stay off hot paths.
+        n_props = len(props)
+        if n_props:
+            pf = jnp.stack([jnp.any(h) for h in ex.prop_hits])
+            sels = [jnp.argmax(h) for h in ex.prop_hits]
+            pfp1 = jnp.stack([row_h1[s] for s in sels])
+            pfp2 = jnp.stack([row_h2[s] for s in sels])
+        else:
+            pf = jnp.zeros(0, dtype=bool)
+            pfp1 = jnp.zeros(0, dtype=jnp.uint32)
+            pfp2 = jnp.zeros(0, dtype=jnp.uint32)
 
         # --- ICI exchange: gather all candidates, keep what I own -------
         def gather(x):
             return lax.all_gather(x, axis, tiled=True)
 
-        g_flat = gather(ex.flat)  # [Nshards*C*A, S]
+        g_flat = tuple(gather(l) for l in ex.flat)
         g_h1 = gather(ex.h1)
         g_h2 = gather(ex.h2)
         g_p1 = gather(ex.parent1)
@@ -86,24 +103,17 @@ def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis:
         g_depth = gather(ex.child_depth)
         g_valid = gather(ex.valid)
 
+        # The claim protocol inside vs.insert resolves in-batch duplicates,
+        # so ownership filtering is the only pre-insert mask needed.
         mine = g_valid & ((g_h1 % u(n_shards)) == me)
-        keep = fr.dedup_mask(g_h1, g_h2, mine)
-        table, is_new, unresolved = vs.insert(table, g_h1, g_h2, g_p1, g_p2, keep)
+        table, is_new, unresolved, _ovf = vs.insert(
+            table, g_h1, g_h2, g_p1, g_p2, mine
+        )
 
-        order, new_count = fr.compact_indices(is_new)
-        packed_rows = g_flat[order]
-        packed_ebits = g_ebits[order]
-        packed_depth = g_depth[order]
-        n_cand = g_h1.shape[0]
-        slot_valid = jnp.arange(n_cand, dtype=jnp.uint32) < new_count
+        new_count = is_new.sum(dtype=jnp.uint32)
+        cand = g_flat + (g_h1, g_h2, g_ebits, g_depth)
         tail = (head + count) & qmask
-        queue = fr.ring_scatter(queue, tail, packed_rows, slot_valid)
-        q_ebits = fr.ring_scatter(
-            q_ebits[:, None], tail, packed_ebits[:, None], slot_valid
-        )[:, 0]
-        q_depth = fr.ring_scatter(
-            q_depth[:, None], tail, packed_depth[:, None], slot_valid
-        )[:, 0]
+        queue = fr.ring_scatter(queue, tail, cand, is_new)
 
         head = (head + take) & qmask
         count = count - take + new_count
@@ -112,15 +122,9 @@ def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis:
         def exp(x):
             return jnp.expand_dims(x, 0)
 
-        pf = ex.prop_found
-        p1 = ex.prop_fp1
-        p2 = ex.prop_fp2
-
         return (
-            exp(table),
-            exp(queue),
-            exp(q_ebits),
-            exp(q_depth),
+            tuple(exp(t) for t in table),
+            tuple(exp(q) for q in queue),
             exp(head),
             exp(count),
             exp(generated),
@@ -129,8 +133,8 @@ def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis:
             exp(max_depth_seen),
             exp(overflow),
             exp(pf),
-            exp(p1),
-            exp(p2),
+            exp(pfp1),
+            exp(pfp2),
         )
 
     return per_device
@@ -169,16 +173,15 @@ class ShardedBfs:
             tm, self._props, chunk_size, self.n_shards, "shards"
         )
         spec = P("shards")
-        n_in = 7
-        n_out = 14
+        # Prefix specs: the table/queue lane tuples share one spec each.
         self._step = jax.jit(
             shard_map(
                 per_device,
                 mesh=self.mesh,
-                in_specs=(spec,) * n_in,
-                out_specs=(spec,) * n_out,
+                in_specs=(spec,) * 5,
+                out_specs=(spec,) * 12,
             ),
-            donate_argnums=(0, 1, 2, 3),
+            donate_argnums=(0, 1),
         )
 
         self.state_count = 0
@@ -194,7 +197,8 @@ class ShardedBfs:
         S = tm.state_width
 
         inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
-        inb = np.asarray(tm.within_boundary_batch(np, inits), dtype=bool)
+        init_lanes = tuple(inits[:, i] for i in range(S))
+        inb = np.asarray(tm.within_boundary_lanes(np, init_lanes), dtype=bool)
         inits = inits[inb]
         self.state_count = len(inits)
         h1, h2 = hash_words_np(inits)
@@ -207,15 +211,19 @@ class ShardedBfs:
                 e += 1
 
         # Route init states to their owner shards; dedup via host set.
-        queue = np.zeros((N, self._qcap, S), dtype=np.uint32)
-        q_ebits = np.full((N, self._qcap), init_ebits, dtype=np.uint32)
-        q_depth = np.ones((N, self._qcap), dtype=np.uint32)
+        # Queue lanes: [state lanes | h1 | h2 | ebits | depth].
+        W = S + 4
+        queue = np.zeros((N, self._qcap, W), dtype=np.uint32)
+        queue[:, :, S + 2] = init_ebits
+        queue[:, :, S + 3] = 1
         counts = np.zeros(N, dtype=np.uint32)
         table = np.zeros((N, self._tcap, 4), dtype=np.uint32)
         seen = set()
         for i in range(len(inits)):
             owner = int(h1[i]) % N
-            queue[owner, counts[owner]] = inits[i]
+            queue[owner, counts[owner], :S] = inits[i]
+            queue[owner, counts[owner], S] = h1[i]
+            queue[owner, counts[owner], S + 1] = h2[i]
             counts[owner] += 1
             fp = combine64(h1[i], h2[i])
             if fp not in seen:
@@ -224,10 +232,8 @@ class ShardedBfs:
                 self._host_insert(table[owner], int(h1[i]), int(h2[i]))
                 self.unique_state_count += 1
 
-        table = jnp.asarray(table)
-        queue = jnp.asarray(queue)
-        q_ebits = jnp.asarray(q_ebits)
-        q_depth = jnp.asarray(q_depth)
+        table = tuple(jnp.asarray(table[:, :, i]) for i in range(4))
+        queue = tuple(jnp.asarray(queue[:, :, i]) for i in range(W))
         head = jnp.zeros(N, dtype=jnp.uint32)
         count = jnp.asarray(counts)
         depth_limit = jnp.full(
@@ -244,8 +250,6 @@ class ShardedBfs:
             (
                 table,
                 queue,
-                q_ebits,
-                q_depth,
                 head,
                 count,
                 generated,
@@ -256,7 +260,7 @@ class ShardedBfs:
                 pf,
                 p1,
                 p2,
-            ) = self._step(table, queue, q_ebits, q_depth, head, count, depth_limit)
+            ) = self._step(table, queue, head, count, depth_limit)
             if bool(np.asarray(overflow).any()):
                 raise RuntimeError(
                     "per-shard frontier ring overflow; increase "
@@ -283,15 +287,19 @@ class ShardedBfs:
                         self.discovery_fps[p.name] = combine64(
                             p1_np[d, i], p2_np[d, i]
                         )
-        self._table = np.asarray(table)
+        self._table = tuple(np.asarray(t) for t in table)
         return self
 
     @staticmethod
     def _host_insert(table_shard: np.ndarray, h1: int, h2: int) -> None:
+        # Must trace the SAME probe sequence as the device insert (double
+        # hashing, stride = h2|1) or device probes will never find
+        # host-seeded entries.
         cap = table_shard.shape[0]
+        stride = (h2 | 1) & 0xFFFFFFFF
         idx = h1 & (cap - 1)
         while table_shard[idx, 0] != 0 or table_shard[idx, 1] != 0:
             if table_shard[idx, 0] == h1 and table_shard[idx, 1] == h2:
                 return
-            idx = (idx + 1) & (cap - 1)
+            idx = (idx + stride) & (cap - 1)
         table_shard[idx] = (h1, h2, 0, 0)

@@ -132,9 +132,12 @@ def _serialize(
     for thread_id in sorted(remaining):
         history = remaining[thread_id]
         if not history:
-            op = in_flight.get(thread_id)
-            if op is None:
+            # Membership check (not a None sentinel): an in-flight op that is
+            # literally None must still serialize, mirroring the reference's
+            # contains_key and the linearizability tester.
+            if thread_id not in in_flight:
                 continue
+            op = in_flight[thread_id]
             obj = ref_obj.copy()
             ret = obj.invoke(op)
             next_valid = valid_history + [(op, ret)]

@@ -3,29 +3,32 @@
 This is the keystone of the TPU-first design (no reference counterpart — the
 reference explores Rust object graphs; SURVEY.md section 7 step 3). A
 `TensorModel` describes the same transition system as a `Model`, but as pure
-array programs over fixed-width uint32 state rows:
+array programs in **structure-of-arrays (lanes) form**:
 
-  - a state is a `[S]` uint32 vector (`state_width` lanes),
-  - a batch of states is `[B, S]`,
-  - `step_batch(xp, states)` maps `[B, S] -> ([B, A, S] successors,
-    [B, A] validity mask)` where `A = max_actions` is the static fanout bound
-    (ragged action sets become masked padding — XLA needs static shapes),
-  - properties are batched predicates `[B, S] -> [B]` bool.
+  - a state is `state_width` uint32 *lanes*; a batch of B states is a tuple
+    of `state_width` dense `[B]` arrays (NOT a `[B, S]` matrix — on TPU a
+    row-major matrix with a small minor axis wastes the 8x128 vector tiles
+    on every gather/scatter, measured at >1000x slowdown in the hot loop),
+  - `step_lanes(xp, lanes)` returns, for each of the `max_actions` static
+    action slots, the successor's lanes plus a validity mask — ragged
+    action sets become masked slots (XLA needs static shapes),
+  - properties are batched predicates over lanes: `check(xp, lanes) -> [B]`.
 
-`step_batch` receives the array namespace `xp` (numpy or jax.numpy) so one
+`step_lanes` receives the array namespace `xp` (numpy or jax.numpy) so one
 definition serves both the host engines (vectorized numpy, and single-row via
-the `TensorModelAdapter`) and the TPU engine (jit + vmap over the frontier).
+the `TensorModelAdapter`) and the TPU engine (jit over the frontier).
 Keeping a single source of truth is what makes host/TPU discovery-output
 equivalence checkable.
 
 Fingerprints of tensor states are computed by the shared word-stream hash
-(`stateright_tpu.fingerprint.hash_words_*`), bit-identical on host and device.
+(`stateright_tpu.fingerprint.hash_lanes_*`), bit-identical on host and
+device and bit-identical to the row form `hash_words_*`.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,7 +38,7 @@ from .fingerprint import combine64, hash_words_np
 
 @dataclass
 class TensorProperty:
-    """A batched property predicate: check(xp, states[B,S]) -> bool[B]."""
+    """A batched property predicate: check(xp, lanes) -> bool[B]."""
 
     expectation: Expectation
     name: str
@@ -55,11 +58,11 @@ class TensorProperty:
 
 
 class TensorModel:
-    """A transition system over fixed-width uint32 state rows.
+    """A transition system over fixed-width uint32 state lanes.
 
     Subclasses define `state_width`, `max_actions`, `init_states_array`,
-    `step_batch`, and `tensor_properties`; optionally
-    `within_boundary_batch`, `decode_state` / `format_action` for display.
+    `step_lanes`, and `tensor_properties`; optionally
+    `within_boundary_lanes`, `decode_state` / `format_action` for display.
     """
 
     state_width: int
@@ -68,15 +71,17 @@ class TensorModel:
     # -- required interface -------------------------------------------------
 
     def init_states_array(self) -> np.ndarray:
-        """[N0, S] uint32 initial states."""
+        """[N0, S] uint32 initial states (host-side; row form is fine here)."""
         raise NotImplementedError
 
-    def step_batch(self, xp, states):
-        """states[B, S] -> (succs[B, A, S], mask[B, A] bool).
+    def step_lanes(self, xp, lanes):
+        """lanes (tuple of S uint32 [B] arrays) ->
+        (succs: list over A of tuples of S [B] lanes, valid: list over A of
+        bool [B] masks).
 
         Must be a pure array program valid under jax.jit (no data-dependent
         Python control flow; elementwise/gather ops only) and equally valid
-        under numpy. Invalid action slots may contain arbitrary state data —
+        under numpy. Invalid action slots may contain arbitrary lane data —
         they are masked out.
         """
         raise NotImplementedError
@@ -86,9 +91,9 @@ class TensorModel:
 
     # -- optional interface -------------------------------------------------
 
-    def within_boundary_batch(self, xp, states):
-        """states[B, S] -> bool[B]; default: everything is in bounds."""
-        return xp.ones(states.shape[0], dtype=bool)
+    def within_boundary_lanes(self, xp, lanes):
+        """lanes -> bool[B]; default: everything is in bounds."""
+        return xp.ones(lanes[0].shape, dtype=bool)
 
     def decode_state(self, row: np.ndarray) -> Any:
         """Human-readable view of one state row (Explorer / error messages)."""
@@ -108,6 +113,11 @@ class TensorModel:
         return TensorModelAdapter(self).checker()
 
 
+def lanes_of_rows(xp, rows):
+    """[B, S] row matrix -> tuple of S [B] lane arrays."""
+    return tuple(rows[:, i] for i in range(rows.shape[1]))
+
+
 class _AdapterProperty:
     """Bridges a TensorProperty to a host (model, state) predicate."""
 
@@ -115,8 +125,8 @@ class _AdapterProperty:
         self._tp = tensor_prop
 
     def __call__(self, model: "TensorModelAdapter", state: Tuple[int, ...]) -> bool:
-        row = np.asarray(state, dtype=np.uint32)[None, :]
-        return bool(np.asarray(self._tp.check(np, row))[0])
+        lanes = tuple(np.asarray([v], dtype=np.uint32) for v in state)
+        return bool(np.asarray(self._tp.check(np, lanes))[0])
 
 
 class TensorModelAdapter(Model):
@@ -132,7 +142,7 @@ class TensorModelAdapter(Model):
         self.tm = tensor_model
         # Single-entry step memo: engines call actions(s) then next_state(s, a)
         # once per action on the same state, which would otherwise recompute
-        # the full step_batch A+1 times per expansion.
+        # the full step A+1 times per expansion.
         self._memo_key: Optional[Tuple[int, ...]] = None
         self._memo_val: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
@@ -161,8 +171,8 @@ class TensorModelAdapter(Model):
         ]
 
     def within_boundary(self, state) -> bool:
-        row = np.asarray(state, dtype=np.uint32)[None, :]
-        return bool(np.asarray(self.tm.within_boundary_batch(np, row))[0])
+        lanes = tuple(np.asarray([v], dtype=np.uint32) for v in state)
+        return bool(np.asarray(self.tm.within_boundary_lanes(np, lanes))[0])
 
     def format_action(self, action: int) -> str:
         return self.tm.format_action(action)
@@ -177,11 +187,16 @@ class TensorModelAdapter(Model):
         key = tuple(state)
         if key == self._memo_key and self._memo_val is not None:
             return self._memo_val
-        row = np.asarray(state, dtype=np.uint32)[None, :]
-        succs, mask = self.tm.step_batch(np, row)
-        val = (
-            np.asarray(succs, dtype=np.uint32)[0],
-            np.asarray(mask, dtype=bool)[0],
-        )
+        lanes = tuple(np.asarray([v], dtype=np.uint32) for v in state)
+        succs, valid = self.tm.step_lanes(np, lanes)
+        A = self.tm.max_actions
+        S = self.tm.state_width
+        succ_rows = np.zeros((A, S), dtype=np.uint32)
+        mask = np.zeros(A, dtype=bool)
+        for a in range(A):
+            mask[a] = bool(np.asarray(valid[a])[0])
+            for s in range(S):
+                succ_rows[a, s] = np.asarray(succs[a][s], dtype=np.uint32)[0]
+        val = (succ_rows, mask)
         self._memo_key, self._memo_val = key, val
         return val

@@ -26,7 +26,8 @@ class IncrementTensorFull(IncrementTensor):
     def tensor_properties(self):
         return super().tensor_properties() + [
             TensorProperty.sometimes(
-                "unreachable", lambda xp, states: xp.zeros(states.shape[0], dtype=bool)
+                "unreachable",
+                lambda xp, lanes: xp.zeros(lanes[0].shape, dtype=bool),
             )
         ]
 

@@ -27,8 +27,18 @@ def _wait_until(predicate, timeout=5.0):
     return predicate()
 
 
-def test_ping_pong_over_udp():
-    base = 42000
+def _engines():
+    from stateright_tpu.native import runtime as native_runtime
+
+    engines = ["python"]
+    if native_runtime.is_available():
+        engines.append("native")
+    return engines
+
+
+@pytest.mark.parametrize("engine", _engines())
+def test_ping_pong_over_udp(engine):
+    base = 42000 + (10 if engine == "native" else 0)
     a = Id.from_addr("127.0.0.1", base)
     b = Id.from_addr("127.0.0.1", base + 1)
     handle = spawn(
@@ -36,15 +46,19 @@ def test_ping_pong_over_udp():
         make_json_deserializer(Ping, Pong),
         [(a, PingPongActor(serve_to=b)), (b, PingPongActor())],
         background=True,
+        engine=engine,
     )
     try:
         # Counters climb as the pair bounces Ping/Pong over loopback.
-        assert _wait_until(lambda: handle.state(a) >= 3 and handle.state(b) >= 3)
+        assert _wait_until(
+            lambda: (handle.state(a) or 0) >= 3 and (handle.state(b) or 0) >= 3
+        )
     finally:
         handle.shutdown()
 
 
-def test_timers_fire():
+@pytest.mark.parametrize("engine", _engines())
+def test_timers_fire(engine):
     class TickActor(Actor):
         def on_start(self, id, out):
             out.set_timer("tick", (0.01, 0.02))
@@ -54,15 +68,16 @@ def test_timers_fire():
             out.set_timer("tick", (0.01, 0.02))
             return state + 1
 
-    addr = Id.from_addr("127.0.0.1", 42010)
+    addr = Id.from_addr("127.0.0.1", 42020 + (1 if engine == "native" else 0))
     handle = spawn(
         json_serializer,
         make_json_deserializer(),
         [(addr, TickActor())],
         background=True,
+        engine=engine,
     )
     try:
-        assert _wait_until(lambda: handle.state(addr) >= 3)
+        assert _wait_until(lambda: (handle.state(addr) or 0) >= 3)
     finally:
         handle.shutdown()
 

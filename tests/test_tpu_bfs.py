@@ -49,7 +49,9 @@ def test_increment_race_discovered():
     path = tpu.discovery("fin")
     assert path is not None
     # Validate the counterexample end-to-end: final state violates "fin".
-    final = np.asarray(path.last_state(), dtype=np.uint32)[None, :]
+    final = tuple(
+        np.asarray([v], dtype=np.uint32) for v in path.last_state()
+    )
     prop = next(p for p in tm.tensor_properties() if p.name == "fin")
     assert not bool(np.asarray(prop.check(np, final))[0])
     # BFS discovers a shortest counterexample: the classic 4-step schedule.
@@ -74,15 +76,16 @@ def test_eventually_property_tensor():
         def init_states_array(self):
             return np.zeros((1, 1), dtype=np.uint32)
 
-        def step_batch(self, xp, states):
-            x = states[:, 0]
-            succ = xp.stack([xp.minimum(x + xp.uint32(1), xp.uint32(3))], axis=-1)
-            return succ[:, None, :], (x < xp.uint32(3))[:, None]
+        def step_lanes(self, xp, lanes):
+            x = lanes[0]
+            return [(xp.minimum(x + xp.uint32(1), xp.uint32(3)),)], [
+                x < xp.uint32(3)
+            ]
 
         def tensor_properties(self):
             return [
                 TensorProperty.eventually(
-                    "reaches3", lambda xp, s: s[:, 0] >= xp.uint32(3)
+                    "reaches3", lambda xp, lanes: lanes[0] >= xp.uint32(3)
                 )
             ]
 
@@ -90,10 +93,11 @@ def test_eventually_property_tensor():
     tpu.assert_properties()  # no counterexample: every path reaches 3
 
     class Stuck(Counter):
-        def step_batch(self, xp, states):
-            x = states[:, 0]
-            succ = xp.stack([xp.minimum(x + xp.uint32(1), xp.uint32(2))], axis=-1)
-            return succ[:, None, :], (x < xp.uint32(2))[:, None]
+        def step_lanes(self, xp, lanes):
+            x = lanes[0]
+            return [(xp.minimum(x + xp.uint32(1), xp.uint32(2)),)], [
+                x < xp.uint32(2)
+            ]
 
     tpu = tpu_check(Stuck())
     path = tpu.discovery("reaches3")
