@@ -16,10 +16,6 @@ from stateright_tpu import WriteReporter
 from stateright_tpu.actor import Network
 
 
-def _thread_count() -> int:
-    return os.cpu_count() or 1
-
-
 def example_main(
     argv,
     name: str,
@@ -39,7 +35,7 @@ def example_main(
         client_count = int(arg(0, default_client_count))
         network = Network.from_name(arg(1, default_network))
         print(f"Model checking {name} with {client_count} clients.")
-        builder = build_model(client_count, network).checker().threads(_thread_count())
+        builder = build_model(client_count, network).checker()
         if subcommand == "check-dfs":
             checker = builder.spawn_dfs()
         elif subcommand == "check-simulation":
@@ -54,7 +50,7 @@ def example_main(
         print(
             f"Exploring state space for {name} with {client_count} clients on {address}."
         )
-        build_model(client_count, network).checker().threads(_thread_count()).serve(
+        build_model(client_count, network).checker().serve(
             address
         )
     elif subcommand == "spawn":

@@ -13,7 +13,6 @@ Usage::
 
 from __future__ import annotations
 
-import os
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -26,14 +25,13 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     subcommand = argv[0] if argv else "check"
     thread_count = int(argv[1]) if len(argv) > 1 else 2
-    threads = os.cpu_count() or 1
     print(f"Model checking increment with {thread_count} threads.")
     if subcommand == "check":
-        Increment(thread_count).checker().threads(threads).spawn_dfs().report(
+        Increment(thread_count).checker().spawn_dfs().report(
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-sym":
-        Increment(thread_count).checker().threads(threads).symmetry().spawn_dfs().report(
+        Increment(thread_count).checker().symmetry().spawn_dfs().report(
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-tpu":

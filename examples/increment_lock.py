@@ -12,7 +12,6 @@ Usage::
 
 from __future__ import annotations
 
-import os
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -25,16 +24,13 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     subcommand = argv[0] if argv else "check"
     thread_count = int(argv[1]) if len(argv) > 1 else 3
-    threads = os.cpu_count() or 1
     print(f"Model checking increment with {thread_count} threads.")
     if subcommand == "check":
-        IncrementLock(thread_count).checker().threads(threads).spawn_dfs().report(
+        IncrementLock(thread_count).checker().spawn_dfs().report(
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-sym":
-        IncrementLock(thread_count).checker().threads(
-            threads
-        ).symmetry().spawn_dfs().report(WriteReporter(sys.stdout))
+        IncrementLock(thread_count).checker().symmetry().spawn_dfs().report(WriteReporter(sys.stdout))
     elif subcommand == "check-tpu":
         IncrementLockTensor(thread_count).checker().spawn_tpu_bfs().report(
             WriteReporter(sys.stdout)

@@ -14,7 +14,7 @@ Usage::
 
 from __future__ import annotations
 
-import os
+
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -31,10 +31,9 @@ def main(argv=None):
         return argv[1 + i] if len(argv) > 1 + i else default
 
     rm_count = int(arg(0, 3))
-    threads = os.cpu_count() or 1
     if subcommand == "check":
         print(f"Model checking two phase commit with {rm_count} resource managers.")
-        TwoPhaseSys(rm_count).checker().threads(threads).spawn_bfs().report(
+        TwoPhaseSys(rm_count).checker().spawn_bfs().report(
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-sym":
@@ -42,7 +41,7 @@ def main(argv=None):
             f"Model checking two phase commit with {rm_count} resource managers "
             "using symmetry reduction."
         )
-        TwoPhaseSys(rm_count).checker().threads(threads).symmetry().spawn_dfs().report(
+        TwoPhaseSys(rm_count).checker().symmetry().spawn_dfs().report(
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-tpu":

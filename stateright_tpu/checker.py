@@ -74,6 +74,14 @@ class CheckerBuilder:
         return self
 
     def threads(self, thread_count: int) -> "CheckerBuilder":
+        """Worker count for engines that support parallel checking.
+
+        The host Python engines are single-threaded by design (state-space
+        parallelism is the device engine's job — `spawn_tpu_bfs`); they
+        raise NotImplementedError for thread_count > 1 rather than silently
+        ignoring it. The device engine accepts any value (its parallelism
+        is the data-parallel chunk, not worker threads).
+        """
         self.thread_count_ = thread_count
         return self
 
@@ -112,6 +120,17 @@ class CheckerBuilder:
         from .engines.tpu_bfs import TpuBfsChecker
 
         return TpuBfsChecker(self, **kw)
+
+    def spawn_sharded_bfs(self, **kw) -> "Checker":
+        """The multi-device sharded BFS engine over a TensorModel.
+
+        Tables and frontiers shard by fingerprint ownership across a
+        `jax.sharding.Mesh`; candidates cross the ICI once, to their owner,
+        via all_to_all (parallel/mesh.py).
+        """
+        from .parallel.mesh import ShardedBfsChecker
+
+        return ShardedBfsChecker(self, **kw)
 
     def serve(self, address: str):
         """Start the Explorer web service. Reference: checker.rs:144-151."""

@@ -23,7 +23,18 @@ BLOCK_SIZE = 1500  # states per finish_when re-check; reference bfs.rs:130
 class HostEngineBase(Checker):
     """Common counters, lifecycle, and property bookkeeping for host engines."""
 
+    # Host engines run one Python worker; parallel checking is the device
+    # engine's job. Engines that genuinely parallelize set this True.
+    _supports_threads = False
+
     def __init__(self, builder: CheckerBuilder):
+        if builder.thread_count_ > 1 and not self._supports_threads:
+            raise NotImplementedError(
+                f"{type(self).__name__} is single-threaded; "
+                "state-space parallelism lives in the batched device engine "
+                "(CheckerBuilder.spawn_tpu_bfs). Drop .threads(n) or use the "
+                "device engine."
+            )
         self._model = builder.model
         self._properties = builder.model.properties()
         self._symmetry = builder.symmetry_fn_

@@ -83,7 +83,8 @@ P_GEN = 8  # OUT: generated states this block
 P_MAXD = 9  # OUT: max depth seen this block
 P_STEPS = 10  # OUT: gated steps actually executed this block
 P_ERR = 11  # OUT: 1 = probe budget exhausted (table overfull)
-P_LEN = 12
+P_TAKE_CAP = 12  # persisted across blocks (self-tuned on rcap overflow)
+P_LEN = 13
 
 
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
@@ -262,7 +263,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             u(0),  # generated delta
             u(0),  # steps actually executed (gate was open)
             u(0),  # unresolved-insert count (checked at block end)
-            u(chunk),  # take_cap (self-tunes on rcap overflow)
+            jnp.minimum(jnp.maximum(params[P_TAKE_CAP], u(1)), u(chunk)),
             tuple(false_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
@@ -277,7 +278,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             gen,
             steps,
             err_cnt,
-            _take_cap,
+            take_cap_out,
             hseen,
             facc1,
             facc2,
@@ -323,6 +324,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
                 maxd,
                 steps,
                 (err_cnt > 0).astype(u),
+                take_cap_out,
             ]
         )
         return table, queue, rec_fp1, rec_fp2, params_out
@@ -334,6 +336,10 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
 class TpuBfsChecker(HostEngineBase):
     """Batched BFS over a TensorModel on the default JAX device."""
 
+    # Parallelism here is the data-parallel chunk, not worker threads;
+    # .threads(n) is accepted (and is a no-op) for API compatibility.
+    _supports_threads = True
+
     def __init__(
         self,
         builder: CheckerBuilder,
@@ -342,6 +348,9 @@ class TpuBfsChecker(HostEngineBase):
         queue_capacity: int = 1 << 20,
         table_capacity: int = 1 << 22,
         sync_steps: int = 512,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: Optional[float] = None,
+        resume_from: Optional[str] = None,
     ):
         model = builder.model
         if isinstance(model, TensorModel):
@@ -383,6 +392,13 @@ class TpuBfsChecker(HostEngineBase):
         self._qcap = queue_capacity
         self._tcap = table_capacity
         self._max_sync_steps = sync_steps
+        # Checkpoint/resume: a capability the reference lacks (its runs are
+        # in-memory only, SURVEY.md §5) — the dense table/ring layout makes
+        # a checkpoint a straight array download.
+        self._ckpt_path = checkpoint_path
+        self._ckpt_every = checkpoint_every
+        self._resume_from = resume_from
+        self._last_ckpt = time.monotonic()
         self._loop = _build_loop(self.tm, self._tprops, self._chunk, self._qcap)
 
         # Host-side bookkeeping.
@@ -415,58 +431,66 @@ class TpuBfsChecker(HostEngineBase):
         W = S + 4  # queue lanes: state | h1 | h2 | ebits | depth
 
         _dbg("run: encoding inits")
-        inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
-        init_lanes = tuple(inits[:, i] for i in range(S))
-        inb = np.asarray(tm.within_boundary_lanes(np, init_lanes), dtype=bool)
-        inits = inits[inb]
-        n_init = len(inits)
-        self._state_count = n_init
-        if n_init == 0:
-            return
-        if n_init > self._qcap:
-            raise ValueError("more initial states than queue capacity")
-
-        # Seed the table with init fingerprints (parent sentinel (0,0)).
-        # The claim protocol in vs.insert resolves duplicate init states.
-        # All init data crosses to the device in ONE upload (each individual
-        # transfer costs a ~100ms round-trip on a remote-attached device).
-        h1, h2 = hash_words_np(inits)
-        qinit = np.zeros((W, n_init), dtype=np.uint32)
-        qinit[:S] = inits.T
-        qinit[S] = h1
-        qinit[S + 1] = h2
-        qinit[S + 2] = self._init_ebits_tensor
-        qinit[S + 3] = 1
-        qinit_dev = jnp.asarray(qinit)  # the one upload
-
-        _dbg("run: seeding table")
-        table = vs.empty_table(self._tcap)
-        zero = jnp.zeros(n_init, dtype=jnp.uint32)
-        table, is_new, unresolved, _ovf = vs.insert_jit(
-            table,
-            qinit_dev[S],
-            qinit_dev[S + 1],
-            zero,
-            zero,
-            jnp.ones(n_init, bool),
-        )
-        stats = np.asarray(
-            jnp.stack(
-                [is_new.sum(dtype=jnp.uint32), unresolved.sum(dtype=jnp.uint32)]
+        if self._resume_from is not None:
+            table, queue, head, count, rec_bits, rec_fp1, rec_fp2 = (
+                self._load_checkpoint(self._resume_from, W)
             )
-        )  # one download
-        assert int(stats[1]) == 0
-        self._unique = int(stats[0])
+            n_init = 1  # resume: counters restored by the loader
+        else:
+            inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
+            init_lanes = tuple(inits[:, i] for i in range(S))
+            inb = np.asarray(
+                tm.within_boundary_lanes(np, init_lanes), dtype=bool
+            )
+            inits = inits[inb]
+            n_init = len(inits)
+            self._state_count = n_init
+            if n_init == 0:
+                return
+            if n_init > self._qcap:
+                raise ValueError("more initial states than queue capacity")
 
-        # Queue lanes: [state lanes | h1 | h2 | ebits | depth]. All init rows
-        # are enqueued, dups included (reference bfs.rs:76-82).
-        queue = tuple(
-            jnp.zeros(self._qcap, dtype=jnp.uint32).at[:n_init].set(qinit_dev[i])
-            for i in range(W)
-        )
-        _dbg("run: seeded; entering block loop")
-        head = 0
-        count = n_init
+            # Seed the table with init fingerprints (parent sentinel (0,0)).
+            # The claim protocol in vs.insert resolves duplicate init states.
+            # All init data crosses to the device in ONE upload (each individual
+            # transfer costs a ~100ms round-trip on a remote-attached device).
+            h1, h2 = hash_words_np(inits)
+            qinit = np.zeros((W, n_init), dtype=np.uint32)
+            qinit[:S] = inits.T
+            qinit[S] = h1
+            qinit[S + 1] = h2
+            qinit[S + 2] = self._init_ebits_tensor
+            qinit[S + 3] = 1
+            qinit_dev = jnp.asarray(qinit)  # the one upload
+
+            _dbg("run: seeding table")
+            table = vs.empty_table(self._tcap)
+            zero = jnp.zeros(n_init, dtype=jnp.uint32)
+            table, is_new, unresolved, _ovf = vs.insert_jit(
+                table,
+                qinit_dev[S],
+                qinit_dev[S + 1],
+                zero,
+                zero,
+                jnp.ones(n_init, bool),
+            )
+            stats = np.asarray(
+                jnp.stack(
+                    [is_new.sum(dtype=jnp.uint32), unresolved.sum(dtype=jnp.uint32)]
+                )
+            )  # one download
+            assert int(stats[1]) == 0
+            self._unique = int(stats[0])
+
+            # Queue lanes: [state lanes | h1 | h2 | ebits | depth]. All init rows
+            # are enqueued, dups included (reference bfs.rs:76-82).
+            queue = tuple(
+                jnp.zeros(self._qcap, dtype=jnp.uint32).at[:n_init].set(qinit_dev[i])
+                for i in range(W)
+            )
+            _dbg("run: seeded; entering block loop")
+            head = 0
+            count = n_init
 
         depth_limit = (
             self._target_max_depth
@@ -475,9 +499,10 @@ class TpuBfsChecker(HostEngineBase):
         )
         high_water = self._qcap - C * A
 
-        rec_bits = 0
-        rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
-        rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
+        if self._resume_from is None:
+            rec_bits = 0
+            rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
+            rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
 
         # Progressive block sizing: gated no-op iterations still pay the
         # width-proportional sort/compaction (~15ms each), so blocks start
@@ -495,6 +520,7 @@ class TpuBfsChecker(HostEngineBase):
         # on a remote-attached device).
         params_dev = None
         last_max_steps = None
+        take_cap = self._chunk
 
         while count > 0 or self._spill:
             host_dirty = params_dev is None
@@ -550,6 +576,7 @@ class TpuBfsChecker(HostEngineBase):
                             0,
                             0,
                             0,
+                            take_cap,
                         ],
                         dtype=np.uint32,
                     )
@@ -580,6 +607,7 @@ class TpuBfsChecker(HostEngineBase):
                 )
             head = int(vals[0])
             count = int(vals[1])
+            take_cap = int(vals[P_TAKE_CAP])
             self._unique = int(vals[2])
             self._state_count += int(vals[8])
             self._max_depth = max(self._max_depth, int(vals[9]))
@@ -617,6 +645,14 @@ class TpuBfsChecker(HostEngineBase):
                 )
                 params_dev = None  # host-side count changed; force re-upload
 
+            if self._ckpt_path is not None and (
+                self._ckpt_every is not None
+                and time.monotonic() - self._last_ckpt >= self._ckpt_every
+            ):
+                self._save_checkpoint(
+                    table, queue, head, count, rec_bits, rec_fp1, rec_fp2
+                )
+
             if self._finish_matched(self._discovery_fps):
                 break
             if (
@@ -626,6 +662,13 @@ class TpuBfsChecker(HostEngineBase):
                 break
             if self._timed_out():
                 break
+
+        # A final checkpoint makes interrupted runs (targets, timeouts)
+        # resumable from their exact stopping point.
+        if self._ckpt_path is not None:
+            self._save_checkpoint(
+                table, queue, head, count, rec_bits, rec_fp1, rec_fp2
+            )
 
         # Retained (on device) for path reconstruction; downloaded lazily.
         self._table_dev = table
@@ -640,6 +683,88 @@ class TpuBfsChecker(HostEngineBase):
         if int(n_unresolved) != 0:
             raise RuntimeError("rehash failed; table pathologically full")
         return new_table, new_cap
+
+    # -- checkpoint/resume --------------------------------------------------
+
+    def _save_checkpoint(
+        self, table, queue, head, count, rec_bits, rec_fp1, rec_fp2
+    ) -> None:
+        """Serialize the full engine state (table, ring, spill, counters) to
+        one .npz; written atomically so a kill mid-save never corrupts the
+        previous checkpoint. The reference has no equivalent — killed runs
+        restart from scratch (SURVEY.md §5)."""
+        import json
+
+        meta = {
+            "head": head,
+            "count": count,
+            "rec_bits": rec_bits,
+            "state_count": self._state_count,
+            "unique": self._unique,
+            "max_depth": self._max_depth,
+            "tcap": self._tcap,
+            "qcap": self._qcap,
+            "chunk": self._chunk,
+            "state_width": self.tm.state_width,
+            "discovery_fps": {
+                k: str(v) for k, v in self._discovery_fps.items()
+            },
+        }
+        arrays = {
+            "meta": np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8
+            ).copy(),
+            "rec_fp1": np.asarray(rec_fp1),
+            "rec_fp2": np.asarray(rec_fp2),
+        }
+        for t in range(4):
+            arrays[f"table{t}"] = np.asarray(table[t])
+        for w, lane in enumerate(queue):
+            arrays[f"queue{w}"] = np.asarray(lane)
+        for i, blk in enumerate(self._spill):
+            arrays[f"spill{i}"] = blk
+        tmp = self._ckpt_path + ".tmp.npz"  # savez appends .npz otherwise
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, self._ckpt_path)
+        self._last_ckpt = time.monotonic()
+        _dbg(f"checkpoint saved: {self._ckpt_path}")
+
+    def _load_checkpoint(self, path: str, W: int):
+        import json
+
+        import jax.numpy as jnp
+
+        data = np.load(path)
+        meta = json.loads(bytes(data["meta"]).decode())
+        if meta["qcap"] != self._qcap or meta["state_width"] != self.tm.state_width:
+            raise ValueError(
+                "checkpoint was written with a different queue capacity or "
+                "model encoding; resume with matching engine options"
+            )
+        self._tcap = meta["tcap"]
+        self._state_count = meta["state_count"]
+        self._unique = meta["unique"]
+        self._max_depth = meta["max_depth"]
+        self._discovery_fps = {
+            k: int(v) for k, v in meta["discovery_fps"].items()
+        }
+        self._spill = [
+            data[k] for k in sorted(
+                (k for k in data.files if k.startswith("spill")),
+                key=lambda s: int(s[5:]),
+            )
+        ]
+        table = tuple(jnp.asarray(data[f"table{t}"]) for t in range(4))
+        queue = tuple(jnp.asarray(data[f"queue{w}"]) for w in range(W))
+        return (
+            table,
+            queue,
+            meta["head"],
+            meta["count"],
+            meta["rec_bits"],
+            jnp.asarray(data["rec_fp1"]),
+            jnp.asarray(data["rec_fp2"]),
+        )
 
     # -- accessors ----------------------------------------------------------
 

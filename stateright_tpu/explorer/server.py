@@ -40,7 +40,7 @@ class _Snapshot:
         self._actions: Optional[List[Any]] = None
         self._lock = threading.Lock()
 
-    def visit(self, model, path) -> None:
+    def visit(self, path) -> None:
         with self._lock:
             if self._armed:
                 self._armed = False

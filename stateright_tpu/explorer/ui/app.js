@@ -1,0 +1,160 @@
+// Explorer SPA logic. Navigation state lives in location.hash as a
+// fingerprint path ("#/fp1/fp2/..."), exactly like the reference UI, so
+// views are linkable and the back button works.
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+let selected = 0; // index into the current successor list
+let lastViews = [];
+
+function fpPath() {
+  return location.hash.replace(/^#/, "").replace(/\/+$/, "");
+}
+
+function verdict(expectation, discovered, done) {
+  if (discovered) {
+    return expectation === "sometimes"
+      ? "✅ example found"
+      : "⚠️ counterexample found";
+  }
+  if (!done) return "🔎 searching…";
+  return expectation === "sometimes"
+    ? "⚠️ example not found"
+    : "✅ holds";
+}
+
+async function pollStatus() {
+  try {
+    const res = await fetch("/.status");
+    const st = await res.json();
+    $("model-name").textContent = st.model;
+    $("progress").textContent = st.done
+      ? "Done."
+      : "Checking… " + (st.recent_path || "");
+    $("counters").textContent =
+      ` states=${st.state_count.toLocaleString()}` +
+      ` unique=${st.unique_state_count.toLocaleString()}` +
+      ` depth=${st.max_depth}`;
+    const ul = $("properties");
+    ul.innerHTML = "";
+    for (const [expectation, name, discovery] of st.properties) {
+      const li = document.createElement("li");
+      const label = document.createElement("span");
+      label.textContent = `${expectation} "${name}": ${verdict(
+        expectation, discovery, st.done)}`;
+      li.appendChild(label);
+      if (discovery) {
+        const a = document.createElement("a");
+        a.textContent = " → view discovery";
+        a.href = "#/" + discovery;
+        li.appendChild(a);
+      }
+      ul.appendChild(li);
+    }
+  } catch (e) {
+    $("progress").textContent = "status unavailable: " + e;
+  }
+  setTimeout(pollStatus, 1000);
+}
+
+function renderBreadcrumbs() {
+  const nav = $("breadcrumbs");
+  nav.innerHTML = "";
+  const root = document.createElement("a");
+  root.textContent = "init";
+  root.href = "#/";
+  nav.appendChild(root);
+  const parts = fpPath().split("/").filter(Boolean);
+  let acc = "";
+  for (const fp of parts) {
+    acc += "/" + fp;
+    nav.appendChild(document.createTextNode(" / "));
+    const a = document.createElement("a");
+    a.textContent = "…" + fp.slice(-6);
+    a.href = "#" + acc;
+    nav.appendChild(a);
+  }
+}
+
+function showDetail(view) {
+  $("detail-state").textContent = view && view.state ? view.state : "";
+  $("detail-svg").innerHTML = view && view.svg ? view.svg : "";
+}
+
+function select(i) {
+  const rows = document.querySelectorAll("#states .state-row");
+  if (!rows.length) return;
+  selected = Math.max(0, Math.min(i, rows.length - 1));
+  rows.forEach((r, k) => r.classList.toggle("selected", k === selected));
+  rows[selected].scrollIntoView({ block: "nearest" });
+  showDetail(lastViews[selected]);
+}
+
+async function loadStates() {
+  renderBreadcrumbs();
+  const section = $("states");
+  section.textContent = "loading…";
+  const res = await fetch("/.states/" + fpPath().split("/").filter(Boolean).join("/"));
+  if (!res.ok) {
+    section.textContent = "error: " + (await res.text());
+    return;
+  }
+  lastViews = await res.json();
+  section.innerHTML = "";
+  lastViews.forEach((v, i) => {
+    const row = document.createElement("div");
+    row.className = "state-row";
+    const action = document.createElement("span");
+    action.className = "action";
+    action.textContent = v.action || "(init)";
+    row.appendChild(action);
+    if (v.outcome) {
+      const out = document.createElement("span");
+      out.className = "outcome";
+      out.textContent = " " + v.outcome;
+      row.appendChild(out);
+    }
+    if (v.fingerprint) {
+      row.addEventListener("click", () => {
+        select(i);
+      });
+      row.addEventListener("dblclick", () => {
+        location.hash = "#/" + fpPath().split("/").filter(Boolean)
+          .concat([v.fingerprint]).join("/");
+      });
+    } else {
+      row.classList.add("ignored");
+      const note = document.createElement("span");
+      note.textContent = " (action ignored)";
+      row.appendChild(note);
+    }
+    section.appendChild(row);
+  });
+  select(0);
+}
+
+document.addEventListener("keydown", (ev) => {
+  if (ev.key === "j") select(selected + 1);
+  else if (ev.key === "k") select(selected - 1);
+  else if (ev.key === "Enter" || ev.key === "l") {
+    const v = lastViews[selected];
+    if (v && v.fingerprint) {
+      location.hash = "#/" + fpPath().split("/").filter(Boolean)
+        .concat([v.fingerprint]).join("/");
+    }
+  } else if (ev.key === "Backspace" || ev.key === "h") {
+    const parts = fpPath().split("/").filter(Boolean);
+    parts.pop();
+    location.hash = "#/" + parts.join("/");
+    ev.preventDefault();
+  }
+});
+
+$("run-to-completion").addEventListener("click", async () => {
+  await fetch("/.runtocompletion", { method: "POST" });
+});
+
+window.addEventListener("hashchange", loadStates);
+pollStatus();
+loadStates();

@@ -1,123 +1,285 @@
-"""Sharded batched BFS: the multi-chip engine core.
+"""Sharded batched BFS: the multi-chip engine.
 
 Design (SURVEY.md §7 step 4, §5 "distributed communication backend"):
 
   - mesh axis "shards" over N devices,
-  - visited table: [N, cap_local, 4] sharded on dim 0 — each device owns
-    the fingerprints with h1 % N == its index,
-  - frontier queue: [N, qcap_local, S] ring buffers, one per device, holding
-    only states that device owns,
-  - per step (one `shard_map`-ped XLA program):
-      1. each device pops a chunk from its local ring and evaluates
-         properties on it (results returned per-device; host merges),
-      2. expands successors locally with the model's batched step,
-      3. `all_gather`s candidate (state, fingerprint, parent, ebits, depth)
-         tuples over the mesh axis — this is the ICI hop, the analogue of
-         the reference's cross-thread job market (src/job_market.rs),
-      4. keeps only candidates it owns, dedups in-batch, scatter-claims
-         into its local table shard, compacts, and appends to its ring.
+  - visited table: fingerprint-ownership sharding — shard `h1 % N` owns a
+    fingerprint; four [N, cap] uint32 lanes (structure-of-arrays, see
+    ops/visited_set.py), sharded on dim 0,
+  - frontier: per-shard ring lanes [N, qcap], holding only owned states,
+  - per block (ONE shard_map'ed jitted program, counted fori loop — the
+    same remote-TPU dispatch constraints as engines/tpu_bfs.py apply):
+      each shard pops a chunk, evaluates properties, expands successors,
+      buckets the candidates BY OWNER into fixed per-destination quotas,
+      and exchanges them with `lax.all_to_all` — each candidate crosses
+      the ICI exactly once, to its owner, instead of the naive
+      all_gather's N-fold broadcast. The owner runs the claim-arbitrated
+      insert (cross-shard duplicates resolve exactly like in-batch ones)
+      and appends fresh states to its ring.
+  - bucket overflow (more candidates for one destination than the quota)
+    uses the same partial-commit protocol as the single-device engine:
+    delivered candidates are inserted+enqueued (idempotent), the pops are
+    NOT consumed, and a per-shard take_cap halves until everything fits.
 
-The all_gather exchange is simple and correct; a sorted all_to_all that
-routes each candidate only to its owner is the planned optimization (it
-cuts ICI traffic by ~N_devices x).
-
-Initial states are pre-routed to their owners on the host. Queue overflow
-raises (size the ring for the model; per-shard spill is future work).
+The host syncs once per block: one [N, P_LEN] stats download, then spill /
+growth / finish-policy decisions. Cross-shard discovery paths reconstruct
+on the host by walking parent pointers across the downloaded table shards
+(owner = h1 % N per hop).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
-from ..fingerprint import combine64, hash_words_jnp, hash_words_np
-from ..tensor import TensorModel
+from ..engines.common import HostEngineBase
+from ..fingerprint import combine64, hash_words_np, split64
+from ..path import Path
+from ..tensor import TensorModel, TensorModelAdapter
+
+# Packed per-shard scalar params (one uint32 row per shard). Mirrors the
+# single-device layout (engines/tpu_bfs.py) plus an overflow counter.
+P_HEAD = 0
+P_COUNT = 1
+P_UNIQUE = 2
+P_REC = 3
+P_DEPTH_LIMIT = 4
+P_GROW_LIMIT = 5
+P_HIGH_WATER = 6
+P_MAX_STEPS = 7
+P_GEN = 8
+P_MAXD = 9
+P_STEPS = 10
+P_ERR = 11
+P_TAKE_CAP = 12  # persisted across blocks (self-tuned on bucket overflow)
+P_LEN = 13
+
+_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
-def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis: str):
+def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
+                 quota: int, mesh, axis: str):
+    key = (
+        id(tm), chunk, qcap, n_shards, quota, len(props),
+        tuple(id(d) for d in mesh.devices.flat),
+    )
+    cached = _LOOP_CACHE.get(key)
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+
     import jax
     import jax.numpy as jnp
-    from jax import lax
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec
 
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
     from ..ops.expand import build_eval_and_expand
 
     S = tm.state_width
+    NP_ = len(props)
     eval_and_expand = build_eval_and_expand(tm, props, chunk)
+    qmask = qcap - 1
+    X = S + 6  # exchanged lanes: state | h1 | h2 | p1 | p2 | ebits | depth
 
-    def per_device(table, queue, head, count, depth_limit):
-        # Local blocks arrive with a leading length-1 shard dim; drop it.
-        # `table` is the 4-lane visited tuple, `queue` the W-lane ring tuple
-        # (structure-of-arrays; see ops/visited_set.py for why).
+    def per_device(table, queue, rec_fp1, rec_fp2, params):
+        u = jnp.uint32
         table = tuple(t[0] for t in table)
         queue = tuple(q[0] for q in queue)
-        head = head[0]
-        count = count[0]
-        depth_limit = depth_limit[0]
+        rec_fp1 = rec_fp1[0]
+        rec_fp2 = rec_fp2[0]
+        params = params[0]
 
-        u = jnp.uint32
         me = lax.axis_index(axis).astype(jnp.uint32)
-        qcap = queue[0].shape[0]
-        qmask = u(qcap - 1)
-        take = jnp.minimum(count, u(chunk))
-        active = jnp.arange(chunk, dtype=jnp.uint32) < take
-        popped, _slots = fr.ring_gather(queue, head, chunk)
-        rows = popped[:S]
-        row_h1 = popped[S]
-        row_h2 = popped[S + 1]
-        ebits = popped[S + 2]
-        depth = popped[S + 3]
+        high_water = params[P_HIGH_WATER]
+        grow_limit = params[P_GROW_LIMIT]
+        depth_limit = params[P_DEPTH_LIMIT]
+        max_steps = params[P_MAX_STEPS]
+        rec_bits = params[P_REC]
 
-        ex = eval_and_expand(
-            rows, row_h1, row_h2, ebits, depth, active, depth_limit
+        def body(_i, carry):
+            (
+                table,
+                queue,
+                head,
+                count,
+                unique,
+                gen,
+                steps,
+                err_cnt,
+                take_cap,
+                hseen,
+                facc1,
+                facc2,
+                faccd,
+            ) = carry
+            # GLOBAL congestion gate: a shard cannot refuse all_to_all
+            # deliveries (they are already inserted in its table), so no
+            # shard may pop while ANY shard's ring or table is within one
+            # step's worth (N*quota) of its limit — that bounds every
+            # shard's receives to exactly the headroom the limits reserve.
+            congested = lax.psum(
+                ((count > high_water) | (unique > grow_limit)).astype(u),
+                axis,
+            )
+            pred = (count > 0) & (congested == u(0))
+            take = jnp.where(
+                pred, jnp.minimum(jnp.minimum(count, u(chunk)), take_cap), u(0)
+            )
+            active = jnp.arange(chunk, dtype=u) < take
+            popped, _ = fr.ring_gather(queue, head, chunk)
+            rows = popped[:S]
+            row_h1 = popped[S]
+            row_h2 = popped[S + 1]
+            ebits = popped[S + 2]
+            depth = popped[S + 3]
+
+            ex = eval_and_expand(
+                rows, row_h1, row_h2, ebits, depth, active, depth_limit
+            )
+
+            # In-batch dedup before the exchange: only first occurrences
+            # travel (duplicates would just lose the claim at the owner).
+            reps = fr.dedup_mask(ex.h1, ex.h2, ex.valid)
+            owner = ex.h1 % u(n_shards)
+
+            # Bucket by owner into [n_shards * quota] send lanes.
+            cand = ex.flat + (
+                ex.h1, ex.h2, ex.parent1, ex.parent2, ex.child_ebits,
+                ex.child_depth,
+            )
+            n_ovf_total = u(0)
+            send = [
+                jnp.zeros(n_shards * quota, dtype=u) + (ex.h1[0] & u(0))
+                for _ in range(X)
+            ]
+            for o in range(n_shards):
+                mask_o = reps & (owner == u(o))
+                ids, valid_o, n_o = vs._compact_ids(mask_o, quota)
+                n_ovf_total = n_ovf_total + n_o - jnp.minimum(n_o, u(quota))
+                for t in range(X):
+                    seg = cand[t][ids] * valid_o.astype(u)
+                    send[t] = lax.dynamic_update_slice(
+                        send[t], seg, (o * quota,)
+                    )
+
+            # The ICI hop: one all_to_all per lane; each shard receives the
+            # buckets addressed to it from every shard.
+            recv = [
+                lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+                for x in send
+            ]
+            rh1 = recv[S]
+            rh2 = recv[S + 1]
+            r_valid = rh1 != u(0)  # fingerprints are nonzero as a pair; an
+            # all-zero exchanged slot means "empty"
+            r_valid = r_valid | (rh2 != u(0))
+
+            table, is_new, unresolved, _ovf_ins = vs.insert(
+                table, rh1, rh2, recv[S + 2], recv[S + 3], r_valid
+            )
+            err_cnt = err_cnt + unresolved.sum(dtype=u)
+            new_count = is_new.sum(dtype=u)
+
+            qrows = tuple(recv[t] for t in range(S)) + (
+                rh1, rh2, recv[S + 4], recv[S + 5]
+            )
+            tail = (head + count) & u(qmask)
+            queue = fr.ring_scatter(queue, tail, qrows, is_new)
+
+            # Partial-commit overflow protocol (see module docstring).
+            ovf = n_ovf_total > u(0)
+            consumed = jnp.where(ovf, u(0), take)
+            head = (head + consumed) & u(qmask)
+            count = count - consumed + new_count
+            unique = unique + new_count
+            gen = gen + jnp.where(ovf, u(0), ex.generated)
+            steps = steps + (pred & ~ovf).astype(u)
+            take_cap = jnp.where(
+                ovf,
+                jnp.maximum(take >> u(1), u(1)),
+                jnp.minimum(take_cap + u(max(1, chunk // 16)), u(chunk)),
+            )
+
+            if NP_:
+                hseen_n, facc1_n, facc2_n, faccd_n = [], [], [], []
+                for pi in range(NP_):
+                    hits = ex.prop_hits[pi]
+                    first = hits & ~hseen[pi]
+                    facc1_n.append(jnp.where(first, row_h1, facc1[pi]))
+                    facc2_n.append(jnp.where(first, row_h2, facc2[pi]))
+                    faccd_n.append(jnp.where(first, depth, faccd[pi]))
+                    hseen_n.append(hseen[pi] | hits)
+                hseen = tuple(hseen_n)
+                facc1 = tuple(facc1_n)
+                facc2 = tuple(facc2_n)
+                faccd = tuple(faccd_n)
+
+            return (
+                table, queue, head, count, unique, gen, steps, err_cnt,
+                take_cap, hseen, facc1, facc2, faccd,
+            )
+
+        zero_lane = jnp.zeros(chunk, dtype=u) + (params[0] & u(0))
+        false_lane = zero_lane != 0
+        # Scalars seeded from varying data so carry types stay consistent
+        # under shard_map (constants would be unvarying on the mesh axis).
+        vzero = params[0] & u(0)
+        init = (
+            table,
+            queue,
+            params[P_HEAD],
+            params[P_COUNT],
+            params[P_UNIQUE],
+            vzero,
+            vzero,
+            vzero,
+            jnp.minimum(jnp.maximum(params[P_TAKE_CAP], u(1)), u(chunk)),
+            tuple(false_lane for _ in range(NP_)),
+            tuple(zero_lane for _ in range(NP_)),
+            tuple(zero_lane for _ in range(NP_)),
+            tuple(zero_lane for _ in range(NP_)),
         )
-        generated = ex.generated
-        max_depth_seen = jnp.max(jnp.where(active, depth, u(0)))
-        # Discovery extraction per step is fine here: this program runs once
-        # per host call (no device loop), so argmax/max stay off hot paths.
-        n_props = len(props)
-        if n_props:
-            pf = jnp.stack([jnp.any(h) for h in ex.prop_hits])
-            sels = [jnp.argmax(h) for h in ex.prop_hits]
-            pfp1 = jnp.stack([row_h1[s] for s in sels])
-            pfp2 = jnp.stack([row_h2[s] for s in sels])
-        else:
-            pf = jnp.zeros(0, dtype=bool)
-            pfp1 = jnp.zeros(0, dtype=jnp.uint32)
-            pfp2 = jnp.zeros(0, dtype=jnp.uint32)
+        (
+            table, queue, head, count, unique, gen, steps, err_cnt,
+            take_cap_out, hseen, facc1, facc2, faccd,
+        ) = lax.fori_loop(u(0), max_steps, body, init)
 
-        # --- ICI exchange: gather all candidates, keep what I own -------
-        def gather(x):
-            return lax.all_gather(x, axis, tiled=True)
-
-        g_flat = tuple(gather(l) for l in ex.flat)
-        g_h1 = gather(ex.h1)
-        g_h2 = gather(ex.h2)
-        g_p1 = gather(ex.parent1)
-        g_p2 = gather(ex.parent2)
-        g_ebits = gather(ex.child_ebits)
-        g_depth = gather(ex.child_depth)
-        g_valid = gather(ex.valid)
-
-        # The claim protocol inside vs.insert resolves in-batch duplicates,
-        # so ownership filtering is the only pre-insert mask needed.
-        mine = g_valid & ((g_h1 % u(n_shards)) == me)
-        table, is_new, unresolved, _ovf = vs.insert(
-            table, g_h1, g_h2, g_p1, g_p2, mine
+        # Block epilogue (once per block): BLOCK-LOCAL discovery reports.
+        # The host keeps the min-depth discovery across blocks and shards —
+        # shards skew, so a shallower hit can surface in a LATER block than
+        # a deeper one (the reference's multithreaded BFS has the same
+        # benign race, bfs.rs:243-244; tracking min depth host-side makes
+        # us strictly better, not just equal).
+        rec_bits_out = rec_bits
+        disc_depth = jnp.zeros(NP_, dtype=u) + (params[0] & u(0))
+        for pi in range(NP_):
+            found = jnp.any(hseen[pi])
+            sel = jnp.argmin(jnp.where(hseen[pi], faccd[pi], u(0xFFFFFFFF)))
+            rec_fp1 = rec_fp1.at[pi].set(
+                jnp.where(found, facc1[pi][sel], u(0))
+            )
+            rec_fp2 = rec_fp2.at[pi].set(
+                jnp.where(found, facc2[pi][sel], u(0))
+            )
+            disc_depth = disc_depth.at[pi].set(
+                jnp.where(found, faccd[pi][sel], u(0xFFFFFFFF))
+            )
+            rec_bits_out = rec_bits_out | (found.astype(u) << u(pi))
+        maxd = jnp.where(
+            steps > 0, queue[S + 3][(head - u(1)) & u(qmask)], u(0)
         )
-
-        new_count = is_new.sum(dtype=jnp.uint32)
-        cand = g_flat + (g_h1, g_h2, g_ebits, g_depth)
-        tail = (head + count) & qmask
-        queue = fr.ring_scatter(queue, tail, cand, is_new)
-
-        head = (head + take) & qmask
-        count = count - take + new_count
-        overflow = count > u(qcap)
+        params_out = jnp.stack(
+            [
+                head, count, unique, rec_bits_out, depth_limit, grow_limit,
+                high_water, max_steps, gen, maxd, steps,
+                (err_cnt > 0).astype(u), take_cap_out,
+            ]
+        )
 
         def exp(x):
             return jnp.expand_dims(x, 0)
@@ -125,176 +287,285 @@ def _build_sharded_step(tm: TensorModel, props, chunk: int, n_shards: int, axis:
         return (
             tuple(exp(t) for t in table),
             tuple(exp(q) for q in queue),
-            exp(head),
-            exp(count),
-            exp(generated),
-            exp(new_count),
-            exp(unresolved.sum(dtype=jnp.uint32)),
-            exp(max_depth_seen),
-            exp(overflow),
-            exp(pf),
-            exp(pfp1),
-            exp(pfp2),
+            exp(rec_fp1),
+            exp(rec_fp2),
+            exp(params_out),
+            exp(disc_depth),
         )
 
-    return per_device
+    spec = PartitionSpec(axis)
+    block = jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(spec,) * 5,
+            out_specs=(spec,) * 6,
+        ),
+        donate_argnums=(0, 1),
+    )
+    _LOOP_CACHE[key] = (tm, block)
+    return block
 
 
-class ShardedBfs:
-    """Host driver for the sharded batched BFS across a device mesh."""
+class ShardedBfsChecker(HostEngineBase):
+    """Multi-device batched BFS behind the standard Checker API.
+
+    Spawn with `CheckerBuilder.spawn_sharded_bfs()`. Tables and frontiers
+    are fingerprint-ownership-sharded across the device mesh; see module
+    docstring.
+    """
+
+    _supports_threads = True  # parallelism = the mesh, not worker threads
 
     def __init__(
         self,
-        tm: TensorModel,
-        devices: Optional[List] = None,
+        builder: CheckerBuilder,
         *,
+        devices: Optional[List] = None,
         chunk_size: int = 1024,
-        queue_capacity_per_shard: int = 1 << 14,
-        table_capacity_per_shard: int = 1 << 16,
-        target_max_depth: Optional[int] = None,
+        queue_capacity_per_shard: int = 1 << 16,
+        table_capacity_per_shard: int = 1 << 18,
+        sync_steps: int = 64,
     ):
         import jax
-        from jax import shard_map
-        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.sharding import Mesh
 
-        self.tm = tm
-        self._props = tm.tensor_properties()
+        model = builder.model
+        if isinstance(model, TensorModel):
+            model = TensorModelAdapter(model)
+            builder.model = model
+        if not isinstance(model, TensorModelAdapter):
+            raise TypeError(
+                "spawn_sharded_bfs requires a TensorModel (or its adapter)"
+            )
+        super().__init__(builder)
+        if self._visitor is not None:
+            raise ValueError("the sharded engine does not support visitors")
+
+        self.tm: TensorModel = model.tm
+        self._tprops = self.tm.tensor_properties()
+        if len(self._tprops) > 32:
+            raise ValueError("at most 32 tensor properties supported")
         devices = devices if devices is not None else jax.devices()
         self.n_shards = len(devices)
         self.mesh = Mesh(np.array(devices), ("shards",))
-        self._chunk = chunk_size
+        if queue_capacity_per_shard & (queue_capacity_per_shard - 1):
+            raise ValueError("queue capacity must be a power of two")
+        A = max(1, self.tm.max_actions)
+        self._chunk = min(chunk_size, queue_capacity_per_shard // (2 * A))
+        if self._chunk == 0:
+            raise ValueError("queue capacity too small for this model's fanout")
         self._qcap = queue_capacity_per_shard
         self._tcap = table_capacity_per_shard
-        self._target_max_depth = target_max_depth
-        if self._qcap & (self._qcap - 1) or self._tcap & (self._tcap - 1):
-            raise ValueError("capacities must be powers of two")
-
-        per_device = _build_sharded_step(
-            tm, self._props, chunk_size, self.n_shards, "shards"
-        )
-        spec = P("shards")
-        # Prefix specs: the table/queue lane tuples share one spec each.
-        self._step = jax.jit(
-            shard_map(
-                per_device,
-                mesh=self.mesh,
-                in_specs=(spec,) * 5,
-                out_specs=(spec,) * 12,
-            ),
-            donate_argnums=(0, 1),
+        self._max_sync_steps = sync_steps
+        # Per-destination exchange quota: the receive width is
+        # n_shards * quota, so this also caps per-step inserts per shard.
+        self._quota = max(64, (self._chunk * A) // (4 * self.n_shards))
+        if self._qcap < 4 * self.n_shards * self._quota:
+            raise ValueError(
+                "queue_capacity_per_shard must be at least 4 * n_shards * "
+                f"quota (= {4 * self.n_shards * self._quota}); got "
+                f"{self._qcap}. Raise the queue capacity or lower chunk_size."
+            )
+        self._block = _build_block(
+            self.tm, self._tprops, self._chunk, self._qcap, self.n_shards,
+            self._quota, self.mesh, "shards",
         )
 
-        self.state_count = 0
-        self.unique_state_count = 0
-        self.max_depth = 0
-        self.discovery_fps: Dict[str, int] = {}
+        self._unique = 0
+        self._discovery_fps: Dict[str, int] = {}
+        self._spill: List[List[np.ndarray]] = [[] for _ in range(self.n_shards)]
+        self._init_ebits = 0
+        e = 0
+        for p in self._tprops:
+            if p.expectation == Expectation.EVENTUALLY:
+                self._init_ebits |= 1 << e
+                e += 1
+        self._start()
 
-    def run(self, max_steps: int = 1_000_000) -> "ShardedBfs":
+    # -- engine body --------------------------------------------------------
+
+    def _run(self) -> None:
         import jax.numpy as jnp
 
+        from ..ops import visited_set as vs
+
         tm = self.tm
-        N = self.n_shards
         S = tm.state_width
+        A = tm.max_actions
+        C = self._chunk
+        N = self.n_shards
+        NP_ = len(self._tprops)
+        W = S + 4
 
         inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
         init_lanes = tuple(inits[:, i] for i in range(S))
         inb = np.asarray(tm.within_boundary_lanes(np, init_lanes), dtype=bool)
         inits = inits[inb]
-        self.state_count = len(inits)
+        self._state_count = len(inits)
+        if len(inits) == 0:
+            return
         h1, h2 = hash_words_np(inits)
 
-        init_ebits = 0
-        e = 0
-        for p in self._props:
-            if p.expectation == Expectation.EVENTUALLY:
-                init_ebits |= 1 << e
-                e += 1
-
-        # Route init states to their owner shards; dedup via host set.
-        # Queue lanes: [state lanes | h1 | h2 | ebits | depth].
-        W = S + 4
-        queue = np.zeros((N, self._qcap, W), dtype=np.uint32)
-        queue[:, :, S + 2] = init_ebits
-        queue[:, :, S + 3] = 1
-        counts = np.zeros(N, dtype=np.uint32)
-        table = np.zeros((N, self._tcap, 4), dtype=np.uint32)
+        # Route init states to their owners; seed tables host-side with the
+        # SAME double-hash probe sequence the device insert uses.
+        queue_np = np.zeros((N, self._qcap, W), dtype=np.uint32)
+        counts = np.zeros(N, dtype=np.int64)
+        table_np = np.zeros((N, self._tcap, 4), dtype=np.uint32)
         seen = set()
         for i in range(len(inits)):
-            owner = int(h1[i]) % N
-            queue[owner, counts[owner], :S] = inits[i]
-            queue[owner, counts[owner], S] = h1[i]
-            queue[owner, counts[owner], S + 1] = h2[i]
-            counts[owner] += 1
+            o = int(h1[i]) % N
             fp = combine64(h1[i], h2[i])
+            row = queue_np[o, counts[o]]
+            row[:S] = inits[i]
+            row[S] = h1[i]
+            row[S + 1] = h2[i]
+            row[S + 2] = self._init_ebits
+            row[S + 3] = 1
+            counts[o] += 1
             if fp not in seen:
                 seen.add(fp)
-                # Seed the owner's table directly (host-side, pre-run).
-                self._host_insert(table[owner], int(h1[i]), int(h2[i]))
-                self.unique_state_count += 1
+                self._host_insert(table_np[o], int(h1[i]), int(h2[i]))
+                self._unique += 1
 
-        table = tuple(jnp.asarray(table[:, :, i]) for i in range(4))
-        queue = tuple(jnp.asarray(queue[:, :, i]) for i in range(W))
-        head = jnp.zeros(N, dtype=jnp.uint32)
-        count = jnp.asarray(counts)
-        depth_limit = jnp.full(
-            N,
+        table = tuple(jnp.asarray(table_np[:, :, t]) for t in range(4))
+        queue = tuple(jnp.asarray(queue_np[:, :, t]) for t in range(W))
+        rec_fp1 = jnp.zeros((N, NP_), dtype=jnp.uint32)
+        rec_fp2 = jnp.zeros((N, NP_), dtype=jnp.uint32)
+        heads = np.zeros(N, dtype=np.int64)
+
+        depth_limit = (
             self._target_max_depth
             if self._target_max_depth is not None
-            else 0xFFFFFFFF,
-            dtype=jnp.uint32,
+            else 0xFFFFFFFF
         )
+        # The per-step append is bounded by the receive width.
+        high_water = self._qcap - N * self._quota
+        rec_bits = 0
+        sync_steps = 4
+        take_caps = [self._chunk] * N
+        disc_depth_best: Dict[str, int] = {}
+        per_shard_unique = self._per_shard_uniques(table_np)
 
-        for _ in range(max_steps):
-            if int(np.asarray(count).sum()) == 0:
+        while counts.sum() > 0 or any(self._spill[s] for s in range(N)):
+            # Refill spills per shard.
+            for s in range(N):
+                while (
+                    self._spill[s]
+                    and counts[s] + len(self._spill[s][-1]) <= high_water
+                ):
+                    rows = self._spill[s].pop()
+                    k = len(rows)
+                    idx = jnp.asarray(
+                        (heads[s] + counts[s] + np.arange(k)) & (self._qcap - 1)
+                    )
+                    queue = tuple(
+                        queue[t].at[s, idx].set(jnp.asarray(rows[:, t]))
+                        for t in range(W)
+                    )
+                    counts[s] += k
+            if counts.sum() == 0:
                 break
-            (
-                table,
-                queue,
-                head,
-                count,
-                generated,
-                new_count,
-                unresolved,
-                max_depth_seen,
-                overflow,
-                pf,
-                p1,
-                p2,
-            ) = self._step(table, queue, head, count, depth_limit)
-            if bool(np.asarray(overflow).any()):
-                raise RuntimeError(
-                    "per-shard frontier ring overflow; increase "
-                    "queue_capacity_per_shard"
+
+            # Grow ALL shard tables together when any shard nears the load
+            # limit (uniform shapes keep one compiled program).
+            while (
+                max(per_shard_unique) + N * self._quota
+                > vs.MAX_LOAD * self._tcap
+            ):
+                table = self._grow_tables(table)
+            grow_limit = max(
+                0, int(vs.MAX_LOAD * self._tcap) - N * self._quota
+            )
+
+            max_steps = sync_steps
+            if self._target_state_count is not None:
+                remaining = max(
+                    0, self._target_state_count - self._state_count
                 )
-            if int(np.asarray(unresolved).sum()) != 0:
-                raise RuntimeError(
-                    "visited-table probe budget exhausted; increase "
-                    "table_capacity_per_shard"
+                max_steps = max(
+                    1, min(max_steps, 1 + remaining // max(1, N * C * A))
                 )
-            self.state_count += int(np.asarray(generated).sum())
-            self.unique_state_count += int(np.asarray(new_count).sum())
-            self.max_depth = max(self.max_depth, int(np.asarray(max_depth_seen).max()))
-            if self._props:
-                pf_np = np.asarray(pf)
-                p1_np = np.asarray(p1)
-                p2_np = np.asarray(p2)
-                for i, p in enumerate(self._props):
-                    if p.name in self.discovery_fps:
+
+            params_np = np.zeros((N, P_LEN), dtype=np.uint32)
+            for s in range(N):
+                params_np[s] = [
+                    heads[s], counts[s], per_shard_unique[s], rec_bits,
+                    depth_limit, grow_limit, high_water, max_steps,
+                    0, 0, 0, 0, take_caps[s],
+                ]
+            table, queue, rec_fp1, rec_fp2, params, disc_depth = self._block(
+                table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
+            )
+            vals = np.asarray(params)  # the one download per block
+
+            if vals[:, P_ERR].any():
+                raise RuntimeError(
+                    "visited-table probe budget exhausted despite headroom"
+                )
+            heads = vals[:, P_HEAD].astype(np.int64)
+            counts = vals[:, P_COUNT].astype(np.int64)
+            take_caps = list(vals[:, P_TAKE_CAP].astype(np.int64))
+            per_shard_unique = list(vals[:, P_UNIQUE].astype(np.int64))
+            self._unique = int(sum(per_shard_unique))
+            self._state_count += int(vals[:, P_GEN].sum())
+            self._max_depth = max(self._max_depth, int(vals[:, P_MAXD].max()))
+            if int(vals[:, P_STEPS].max()) >= max_steps:
+                sync_steps = min(sync_steps * 2, self._max_sync_steps)
+
+            block_bits = int(np.bitwise_or.reduce(vals[:, P_REC]))
+            if block_bits:
+                fp1 = np.asarray(rec_fp1)
+                fp2 = np.asarray(rec_fp2)
+                depths = np.asarray(disc_depth)  # [N, NP_]
+                for pi, p in enumerate(self._tprops):
+                    if not (block_bits >> pi) & 1:
                         continue
-                    hits = np.nonzero(pf_np[:, i])[0]
-                    if len(hits):
-                        d = hits[0]
-                        self.discovery_fps[p.name] = combine64(
-                            p1_np[d, i], p2_np[d, i]
+                    s = int(np.argmin(depths[:, pi]))
+                    d = int(depths[s, pi])
+                    if (
+                        p.name not in self._discovery_fps
+                        or d < disc_depth_best.get(p.name, 1 << 62)
+                    ):
+                        disc_depth_best[p.name] = d
+                        self._discovery_fps[p.name] = combine64(
+                            fp1[s, pi], fp2[s, pi]
                         )
-        self._table = tuple(np.asarray(t) for t in table)
-        return self
+                rec_bits |= block_bits
+
+            # Per-shard spill.
+            for s in range(N):
+                while counts[s] > high_water:
+                    k = int(min(N * self._quota, counts[s] - high_water))
+                    idx = jnp.asarray(
+                        (heads[s] + counts[s] - k + np.arange(k))
+                        & (self._qcap - 1)
+                    )
+                    block = np.stack(
+                        [np.asarray(queue[t][s, idx]) for t in range(W)],
+                        axis=1,
+                    )
+                    self._spill[s].append(block)
+                    counts[s] -= k
+                    self._max_depth = max(
+                        self._max_depth, int(block[:, S + 3].max())
+                    )
+
+            if self._finish_matched(self._discovery_fps):
+                break
+            if (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                break
+            if self._timed_out():
+                break
+
+        self._table_dev = table
+        return
 
     @staticmethod
     def _host_insert(table_shard: np.ndarray, h1: int, h2: int) -> None:
-        # Must trace the SAME probe sequence as the device insert (double
-        # hashing, stride = h2|1) or device probes will never find
-        # host-seeded entries.
         cap = table_shard.shape[0]
         stride = (h2 | 1) & 0xFFFFFFFF
         idx = h1 & (cap - 1)
@@ -303,3 +574,105 @@ class ShardedBfs:
                 return
             idx = (idx + stride) & (cap - 1)
         table_shard[idx] = (h1, h2, 0, 0)
+
+    def _per_shard_uniques(self, table_np) -> List[int]:
+        return [
+            int(((table_np[s, :, 0] != 0) | (table_np[s, :, 1] != 0)).sum())
+            for s in range(self.n_shards)
+        ]
+
+    def _grow_tables(self, table):
+        """Double every shard's capacity; rehash on device per shard."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import visited_set as vs
+
+        new_cap = self._tcap * 2
+        N = self.n_shards
+        old = [np.asarray(t) for t in table]  # [N, tcap] x 4
+        new_lanes = [np.zeros((N, new_cap), dtype=np.uint32) for _ in range(4)]
+        for s in range(N):
+            shard_old = tuple(jnp.asarray(old[t][s]) for t in range(4))
+            shard_new, unres = vs.rehash_jit(
+                shard_old, vs.empty_table(new_cap)
+            )
+            if int(unres) != 0:
+                raise RuntimeError("rehash failed; table pathologically full")
+            for t in range(4):
+                new_lanes[t][s] = np.asarray(shard_new[t])
+        self._tcap = new_cap
+        return tuple(jnp.asarray(l) for l in new_lanes)
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return self._unique
+
+    def discoveries(self) -> Dict[str, Path]:
+        self.join()
+        return {
+            name: self._reconstruct(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }
+
+    def _reconstruct(self, fp64: int) -> Path:
+        """Walk parent pointers ACROSS shard tables (owner = h1 % N per
+        hop), then re-execute the model along the fingerprint chain."""
+        from ..ops import visited_set as vs
+
+        if not hasattr(self, "_table_np"):
+            self._table_np = [np.asarray(l) for l in self._table_dev]
+        chain = [fp64]
+        cur = fp64
+        for _ in range(10_000_000):
+            h1, h2 = split64(cur)
+            s = h1 % self.n_shards
+            shard = tuple(self._table_np[t][s] for t in range(4))
+            found, p1, p2 = vs.lookup_parent_np(shard, h1, h2)
+            if not found:
+                raise RuntimeError(
+                    f"fingerprint {cur} missing from shard {s} during "
+                    "path reconstruction"
+                )
+            if p1 == 0 and p2 == 0:
+                break
+            cur = combine64(p1, p2)
+            chain.append(cur)
+        chain.reverse()
+        return Path.from_fingerprints(self._model, chain)
+
+
+# Back-compat style helper mirroring the original prototype's interface.
+class ShardedBfs:
+    """Thin wrapper: build a ShardedBfsChecker from a bare TensorModel."""
+
+    def __init__(self, tm: TensorModel, devices=None, **kw):
+        self._tm = tm
+        self._devices = devices
+        self._kw = kw
+        self.checker: Optional[ShardedBfsChecker] = None
+
+    def run(self) -> "ShardedBfs":
+        builder = TensorModelAdapter(self._tm).checker()
+        self.checker = ShardedBfsChecker(
+            builder, devices=self._devices, **self._kw
+        )
+        self.checker.join()
+        return self
+
+    @property
+    def state_count(self):
+        return self.checker.state_count()
+
+    @property
+    def unique_state_count(self):
+        return self.checker.unique_state_count()
+
+    @property
+    def max_depth(self):
+        return self.checker.max_depth()
+
+    @property
+    def discovery_fps(self):
+        return self.checker._discovery_fps

@@ -1,0 +1,62 @@
+"""Checkpoint/resume of the device engine — a capability the reference
+lacks (its runs are in-memory only; SURVEY.md §5 flags this as the natural
+new capability of the dense table/ring layout).
+
+The kill/resume contract: stop a run mid-exploration (here via a
+state-count target, which exits a block boundary exactly like a kill
+would), resume from the checkpoint in a NEW checker, and land on exactly
+the same final counts as an uninterrupted run.
+"""
+
+from stateright_tpu.models import TwoPhaseTensor
+from stateright_tpu.tensor import TensorModelAdapter
+
+OPTS = dict(chunk_size=64, queue_capacity=1 << 12, table_capacity=1 << 11)
+
+
+def test_kill_and_resume_reproduces_golden(tmp_path):
+    ckpt = str(tmp_path / "run.ckpt.npz")
+
+    # Phase 1: explore part of 2pc-5, then stop; the final checkpoint
+    # captures the mid-exploration frontier + visited table.
+    partial = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .target_state_count(2_000)
+        .spawn_tpu_bfs(checkpoint_path=ckpt, **OPTS)
+        .join()
+    )
+    assert 0 < partial.unique_state_count() < 8832
+
+    # Phase 2: a fresh checker resumes and finishes the space exactly.
+    resumed = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_tpu_bfs(resume_from=ckpt, **OPTS)
+        .join()
+    )
+    assert resumed.unique_state_count() == 8832
+    resumed.assert_properties()
+    # Discoveries found before the kill survive the round-trip, and paths
+    # reconstruct from the resumed table.
+    for name in ("abort agreement", "commit agreement"):
+        assert resumed.discovery(name) is not None
+
+
+def test_periodic_checkpoint_written(tmp_path):
+    ckpt = str(tmp_path / "periodic.ckpt.npz")
+    checker = (
+        TensorModelAdapter(TwoPhaseTensor(4))
+        .checker()
+        .spawn_tpu_bfs(checkpoint_path=ckpt, checkpoint_every=0.0, **OPTS)
+        .join()
+    )
+    full = checker.unique_state_count()
+    # Resuming a COMPLETED run is a no-op that reports the same counts.
+    resumed = (
+        TensorModelAdapter(TwoPhaseTensor(4))
+        .checker()
+        .spawn_tpu_bfs(resume_from=ckpt, **OPTS)
+        .join()
+    )
+    assert resumed.unique_state_count() == full

@@ -1,0 +1,125 @@
+"""Device-engine eventually-property matrix: dgraph twins.
+
+Ports the host dgraph eventually cases (reference checker.rs:589-681) to
+small TensorModels so the device ebits/dedup interaction is covered —
+including the reference's PRESERVED false negative on cycles and DAG joins
+(revisiting a state suppresses terminality; checker.rs:663-680). The
+device engine must reproduce that behavior, not "fix" it, to stay
+output-identical with the host engines.
+"""
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from stateright_tpu.tensor import TensorModel, TensorModelAdapter, TensorProperty
+
+
+class DGraphTensor(TensorModel):
+    """A directed graph on small-int states, lanes form (1 lane)."""
+
+    state_width = 1
+
+    def __init__(self, inits: List[int], edges: Dict[int, List[int]]):
+        self.inits = sorted(inits)
+        self.edges = edges
+        self.max_actions = max((len(v) for v in edges.values()), default=1) or 1
+
+    @staticmethod
+    def from_paths(paths: List[List[int]]) -> "DGraphTensor":
+        inits = set()
+        edges: Dict[int, List[int]] = {}
+        for path in paths:
+            inits.add(path[0])
+            for a, b in zip(path, path[1:]):
+                outs = edges.setdefault(a, [])
+                if b not in outs:
+                    outs.append(b)
+        return DGraphTensor(sorted(inits), edges)
+
+    def init_states_array(self) -> np.ndarray:
+        return np.asarray([[v] for v in self.inits], dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        s = lanes[0]
+        u = xp.uint32
+        succs = []
+        masks = []
+        for a in range(self.max_actions):
+            nxt = u(0) * s
+            valid = s != s  # all-false, varying
+            for v, outs in self.edges.items():
+                if a < len(outs):
+                    hit = s == u(v)
+                    nxt = xp.where(hit, u(outs[a]), nxt)
+                    valid = valid | hit
+            succs.append((nxt,))
+            masks.append(valid)
+        return succs, masks
+
+    def tensor_properties(self):
+        return [
+            TensorProperty.eventually(
+                "odd", lambda xp, lanes: (lanes[0] & xp.uint32(1)) == xp.uint32(1)
+            )
+        ]
+
+
+def check(paths: List[List[int]]):
+    tm = DGraphTensor.from_paths(paths)
+    return (
+        TensorModelAdapter(tm)
+        .checker()
+        .spawn_tpu_bfs(chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 8)
+        .join()
+    )
+
+
+def test_device_can_validate():
+    check([[1], [2, 3], [2, 6, 7], [4, 9, 10]]).assert_properties()
+    check([[1]]).assert_properties()
+    check([[2, 3]]).assert_properties()
+    check([[2, 6, 7]]).assert_properties()
+    check([[4, 9, 10]]).assert_properties()
+
+
+def test_device_can_discover_counterexample():
+    # Terminal even states are eventually-"odd" counterexamples; BFS finds
+    # the shortest path to each (checker.rs:612-661 ported to the device).
+    path = check([[0, 1], [0, 2]]).discovery("odd")
+    assert [int(s[0]) for s in path.into_states()] == [0, 2]
+    path = check([[0, 1], [2, 4]]).discovery("odd")
+    assert [int(s[0]) for s in path.into_states()] == [2, 4]
+    path = check([[0, 1, 4, 6], [2, 4, 8]]).discovery("odd")
+    assert [int(s[0]) for s in path.into_states()] == [2, 4, 6]
+
+
+def test_device_fixme_can_miss_counterexample_when_revisiting_a_state():
+    # Cycle: 0 -> 2 -> 4 -> 2 never satisfies "odd" but is never terminal.
+    # The reference documents this false negative (checker.rs:663-680); the
+    # device engine must reproduce it bit-for-bit, not repair it.
+    assert check([[0, 2, 4, 2]]).discovery("odd") is None
+    # DAG join: revisiting 4 suppresses terminality on the second path.
+    assert check([[0, 2, 4], [1, 4, 6]]).discovery("odd") is None
+
+
+def test_device_matches_host_engine_verdicts():
+    # The host adapter run is the oracle for the same tensor models.
+    for paths in (
+        [[1], [2, 3], [2, 6, 7], [4, 9, 10]],
+        [[0, 1], [0, 2]],
+        [[0, 2, 4, 2]],
+        [[0, 2, 4], [1, 4, 6]],
+    ):
+        tm = DGraphTensor.from_paths(paths)
+        host = TensorModelAdapter(tm).checker().spawn_bfs().join()
+        dev = (
+            TensorModelAdapter(tm)
+            .checker()
+            .spawn_tpu_bfs(
+                chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 8
+            )
+            .join()
+        )
+        assert dev.unique_state_count() == host.unique_state_count()
+        assert (dev.discovery("odd") is None) == (host.discovery("odd") is None)

@@ -1,0 +1,124 @@
+"""Explorer server tests: handler-level golden JSON plus a live-socket
+smoke test of the bundled SPA.
+
+Reference parity: the reference drives its HTTP handler functions directly
+with golden JSON, including serialized SVG (src/checker/explorer.rs:322-597);
+this repo's `states_views`/`_status_view` were written to be testable the
+same way.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from stateright_tpu.explorer.server import (
+    ExplorerServer,
+    _status_view,
+    serve,
+    states_views,
+)
+from stateright_tpu.models.fixtures import BinaryClock
+from stateright_tpu.actor.test_util import PingPongActor, ping_pong_model
+
+
+def _on_demand(model):
+    return model.checker().spawn_on_demand()
+
+
+def test_states_views_init_states():
+    checker = _on_demand(BinaryClock())
+    views = states_views(checker, "")
+    # Two init states (0 and 1), each with a fingerprint and per-property
+    # verdict triples (explorer.rs:224-320).
+    assert len(views) == 2
+    assert [v["state"] for v in views] == ["0", "1"]
+    for v in views:
+        assert int(v["fingerprint"]) != 0
+        assert v["properties"] == [["always", "in [0, 1]", None]]
+    checker.run_to_completion()
+    checker.join()
+
+
+def test_states_views_walks_fingerprint_path():
+    checker = _on_demand(BinaryClock())
+    model = checker.model()
+    init_fp = model.fingerprint_state(0)
+    views = states_views(checker, f"/{init_fp}")
+    # From state 0 the only action is GoHigh, leading to state 1.
+    assert len(views) == 1
+    assert views[0]["action"] == "'GoHigh'"
+    assert views[0]["state"] == "1"
+    assert int(views[0]["fingerprint"]) == model.fingerprint_state(1)
+    checker.run_to_completion()
+    checker.join()
+
+
+def test_states_views_rejects_garbage():
+    checker = _on_demand(BinaryClock())
+    with pytest.raises(KeyError, match="Unable to parse fingerprints"):
+        states_views(checker, "/not-a-fingerprint")
+    with pytest.raises(KeyError, match="Unable to find state"):
+        states_views(checker, "/12345")  # no such fingerprint
+    checker.run_to_completion()
+    checker.join()
+
+
+def test_states_views_includes_actor_svg():
+    # Actor models render sequence diagrams for the walked path
+    # (model.rs:550-754 / explorer.rs golden includes the SVG).
+    from stateright_tpu.actor.test_util import PingPongCfg
+
+    model = ping_pong_model(PingPongCfg(max_nat=2))
+    checker = model.checker().spawn_on_demand()
+    init_fp = model.fingerprint_state(model.init_states()[0])
+    views = states_views(checker, f"/{init_fp}")
+    assert any("svg" in v for v in views if "fingerprint" in v)
+    checker.run_to_completion()
+    checker.join()
+
+
+def test_status_view_shape():
+    from stateright_tpu.explorer.server import _Snapshot
+
+    checker = _on_demand(BinaryClock())
+    checker.run_to_completion()
+    checker.join()
+    view = _status_view(checker, checker.model(), _Snapshot())
+    assert view["done"] is True
+    assert view["model"] == "BinaryClock"
+    assert view["unique_state_count"] == 2
+    assert view["properties"] == [["always", "in [0, 1]", None]]
+
+
+def test_live_server_serves_ui_and_api():
+    server = serve(BinaryClock().checker(), "127.0.0.1:0", block=False)
+    try:
+        base = server.url
+
+        def get(path):
+            with urllib.request.urlopen(base.rstrip("/") + path) as r:
+                return r.status, r.read()
+
+        status, body = get("/")
+        assert status == 200 and b"Explorer" in body
+        status, body = get("/app.js")
+        assert status == 200 and b"fingerprint" in body
+        status, body = get("/app.css")
+        assert status == 200
+        status, body = get("/.status")
+        st = json.loads(body)
+        assert st["model"] == "BinaryClock"
+        status, body = get("/.states/")
+        assert len(json.loads(body)) == 2
+
+        req = urllib.request.Request(
+            base.rstrip("/") + "/.runtocompletion", method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        server.checker.join()
+        _, body = get("/.status")
+        assert json.loads(body)["done"] is True
+    finally:
+        server.shutdown()

@@ -1,0 +1,75 @@
+"""Paxos tensor twin: host-oracle equivalence and reference goldens.
+
+The host `examples/paxos.py` ActorModel is the correctness oracle (its own
+golden, 16,668 uniques at 2 clients, matches examples/paxos.rs:327). The
+tensor twin must agree on unique-state counts — which requires its lane
+encoding to capture the FULL host state identity, including the
+linearizability tester's thread histories and real-time snapshots.
+"""
+
+import os
+
+import pytest
+
+from stateright_tpu.models.paxos import PaxosTensor
+from stateright_tpu.tensor import TensorModelAdapter, TensorProperty
+
+
+class PaxosTensorFull(PaxosTensor):
+    """Adds an unreachable property so exhaustive runs match the host model,
+    whose never-discovered "linearizable" always-property keeps the default
+    finish_when=ALL policy from stopping at the first discovery."""
+
+    def tensor_properties(self):
+        return super().tensor_properties() + [
+            TensorProperty.sometimes(
+                "unreachable", lambda xp, lanes: lanes[0] != lanes[0]
+            )
+        ]
+
+
+def test_c1_twin_matches_host_actor_model():
+    from examples.paxos import paxos_model
+
+    host = paxos_model(1, 3).checker().spawn_bfs().join()
+    host.assert_properties()
+    twin = TensorModelAdapter(PaxosTensorFull(1)).checker().spawn_bfs().join()
+    assert twin.unique_state_count() == host.unique_state_count() == 265
+    assert twin.discovery("value chosen") is not None
+
+
+def test_c1_device_engine_matches():
+    twin = (
+        TensorModelAdapter(PaxosTensorFull(1))
+        .checker()
+        .spawn_tpu_bfs(chunk_size=256, queue_capacity=1 << 14, table_capacity=1 << 12)
+        .join()
+    )
+    assert twin.unique_state_count() == 265
+    path = twin.discovery("value chosen")
+    assert path is not None
+    # BFS finds a shortest example; every prefix action must replay.
+    assert len(path.into_actions()) >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("STPU_SLOW"),
+    reason="several-minute CPU run; set STPU_SLOW=1 (covered on TPU by bench.py)",
+)
+def test_c2_device_engine_reference_golden():
+    # The reference's headline golden: 16,668 unique states at 2 clients
+    # (examples/paxos.rs:327), with an 8-step "value chosen" discovery
+    # (paxos.rs:330-340).
+    twin = (
+        TensorModelAdapter(PaxosTensorFull(2))
+        .checker()
+        .spawn_tpu_bfs(
+            chunk_size=1024, queue_capacity=1 << 16, table_capacity=1 << 16
+        )
+        .join()
+    )
+    assert twin.unique_state_count() == 16_668
+    path = twin.discovery("value chosen")
+    assert path is not None
+    assert len(path.into_actions()) == 8

@@ -1,8 +1,9 @@
 """Sharded multi-device BFS on the virtual 8-device CPU mesh.
 
-Validates that fingerprint-ownership sharding over a jax.sharding.Mesh
-explores exactly the same state space as the host oracle and the
-single-device engine.
+Validates that fingerprint-ownership sharding over a jax.sharding.Mesh —
+with the owner-routed all_to_all candidate exchange — explores exactly the
+same state space as the host oracle and the single-device engine, and that
+counterexample paths reconstruct across shard tables.
 """
 
 import jax
@@ -10,6 +11,7 @@ import pytest
 
 from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
 from stateright_tpu.parallel import ShardedBfs
+from stateright_tpu.tensor import TensorModelAdapter
 
 
 @pytest.fixture(scope="module")
@@ -32,6 +34,19 @@ def test_2pc5_sharded_exact_count(devices):
     assert "consistent" not in sb.discovery_fps
 
 
+def test_2pc5_sharded_with_spill_and_growth(devices):
+    # Tiny rings + tables force the spill and grow paths; counts must stay
+    # exact (mirrors the single-device growth/spill test).
+    sb = ShardedBfs(
+        TwoPhaseTensor(5),
+        devices,
+        chunk_size=64,
+        queue_capacity_per_shard=1 << 11,
+        table_capacity_per_shard=1 << 10,
+    ).run()
+    assert sb.unique_state_count == 8832
+
+
 def test_increment_race_sharded(devices):
     sb = ShardedBfs(IncrementTensor(2), devices, chunk_size=64).run()
     assert "fin" in sb.discovery_fps
@@ -40,3 +55,33 @@ def test_increment_race_sharded(devices):
 def test_two_shards_also_exact(devices):
     sb = ShardedBfs(TwoPhaseTensor(3), devices[:2], chunk_size=128).run()
     assert sb.unique_state_count == 288
+
+
+def test_checker_api_and_cross_shard_paths(devices):
+    # The full Checker interface: spawn via the builder, reconstruct a
+    # discovery Path across shard tables, and replay it through the model.
+    checker = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .spawn_sharded_bfs(devices=devices, chunk_size=64)
+        .join()
+    )
+    path = checker.discovery("fin")
+    assert path is not None
+    # BFS shortest counterexample: the classic 4-step lost-update schedule.
+    assert len(path.into_actions()) == 4
+    checker.assert_any_discovery("fin")
+
+
+def test_sharded_matches_single_device_engine(devices):
+    tm = TwoPhaseTensor(4)
+    single = TensorModelAdapter(tm).checker().spawn_tpu_bfs(
+        chunk_size=128, queue_capacity=1 << 13, table_capacity=1 << 13
+    ).join()
+    sharded = (
+        TensorModelAdapter(tm)
+        .checker()
+        .spawn_sharded_bfs(devices=devices, chunk_size=128)
+        .join()
+    )
+    assert sharded.unique_state_count() == single.unique_state_count()
