@@ -39,8 +39,7 @@ def main() -> None:
 
     from stateright_tpu import TensorModelAdapter
     from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
-    from stateright_tpu.models.paxos import PaxosTensor
-    from stateright_tpu.tensor import TensorProperty
+    from stateright_tpu.models.paxos import PaxosTensorExhaustive
 
     detail = {}
 
@@ -85,15 +84,7 @@ def main() -> None:
     }
 
     # --- paxos-2: the reference's flagship workload on device -------------
-    class PaxosFull(PaxosTensor):
-        def tensor_properties(self):
-            return super().tensor_properties() + [
-                TensorProperty.sometimes(
-                    "unreachable", lambda xp, lanes: lanes[0] != lanes[0]
-                )
-            ]
-
-    px = PaxosFull(2)
+    px = PaxosTensorExhaustive(2)
     pxopts = dict(chunk_size=2048, queue_capacity=1 << 18, table_capacity=1 << 20)
     TensorModelAdapter(px).checker().spawn_tpu_bfs(**pxopts).join()  # compile
     t0 = time.perf_counter()

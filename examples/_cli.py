@@ -8,7 +8,6 @@ positional arguments for problem size and network semantics.
 
 from __future__ import annotations
 
-import os
 import sys
 from typing import Callable, Optional
 

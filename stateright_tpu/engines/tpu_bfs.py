@@ -101,6 +101,8 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
+    while len(_LOOP_CACHE) >= 16:  # bound executable/model pinning
+        _LOOP_CACHE.pop(next(iter(_LOOP_CACHE)))
 
     import jax
     import jax.numpy as jnp
@@ -507,7 +509,9 @@ class TpuBfsChecker(HostEngineBase):
         # Progressive block sizing: gated no-op iterations still pay the
         # width-proportional sort/compaction (~15ms each), so blocks start
         # short and double while the search keeps saturating them — big runs
-        # converge to the full budget, small runs never overpay.
+        # converge to the full budget, small runs never overpay. A
+        # frontier-based floor (2 * count/chunk) lets deep frontiers jump
+        # straight to long blocks without waiting out the ramp.
         sync_steps = 4
         max_sync = (
             self._max_sync_steps
@@ -551,7 +555,11 @@ class TpuBfsChecker(HostEngineBase):
                 host_dirty = True
             grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - rcap)
 
-            max_steps = sync_steps
+            # Quantize the frontier-based floor to a power of two so
+            # max_steps pins between blocks and the params passthrough stays
+            # upload-free (a changed max_steps forces a ~100ms re-upload).
+            floor = 2 * ((count + C - 1) // C)
+            max_steps = min(max_sync, max(sync_steps, 1 << (floor - 1).bit_length() if floor > 1 else 1))
             if self._target_state_count is not None:
                 # Bound overshoot past the state-count target: each step
                 # generates at most C*A states.

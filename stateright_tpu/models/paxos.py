@@ -474,3 +474,19 @@ class PaxosTensor(TensorModel):
             for i in range(self.c)
         ]
         return {"servers": servers, "clients": clients, "net": net}
+
+
+class PaxosTensorExhaustive(PaxosTensor):
+    """PaxosTensor plus an unreachable sometimes-property.
+
+    The host model's never-discovered "linearizable" always-property keeps
+    the default finish_when=ALL policy exploring to exhaustion; this twin
+    needs an equivalent blocker so exhaustive runs match the host goldens.
+    """
+
+    def tensor_properties(self):
+        return super().tensor_properties() + [
+            TensorProperty.sometimes(
+                "unreachable", lambda xp, lanes: lanes[0] != lanes[0]
+            )
+        ]

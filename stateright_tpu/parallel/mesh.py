@@ -70,6 +70,8 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
+    while len(_LOOP_CACHE) >= 16:  # bound executable/model pinning
+        _LOOP_CACHE.pop(next(iter(_LOOP_CACHE)))
 
     import jax
     import jax.numpy as jnp

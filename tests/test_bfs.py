@@ -74,15 +74,16 @@ def test_target_max_depth_limits_depth():
     # Depth-3 jobs are popped but skipped, so generated states reach depth 3:
     # (0,0) + {(1,0),(0,1)} + {(2,0),(1,1),(0,2)} = 6 unique states.
     assert checker.unique_state_count() == 6
-import pytest
-from stateright_tpu.models.fixtures import BinaryClock
-
 def test_threads_gt1_raises_on_host_engines():
+    from stateright_tpu.models.fixtures import BinaryClock
+
     with pytest.raises(NotImplementedError, match="single-threaded"):
         BinaryClock().checker().threads(4).spawn_bfs()
     with pytest.raises(NotImplementedError, match="single-threaded"):
         BinaryClock().checker().threads(2).spawn_dfs()
 
 def test_threads_1_is_fine():
+    from stateright_tpu.models.fixtures import BinaryClock
+
     c = BinaryClock().checker().threads(1).spawn_bfs().join()
     assert c.unique_state_count() == 2

@@ -11,21 +11,10 @@ import os
 
 import pytest
 
-from stateright_tpu.models.paxos import PaxosTensor
-from stateright_tpu.tensor import TensorModelAdapter, TensorProperty
-
-
-class PaxosTensorFull(PaxosTensor):
-    """Adds an unreachable property so exhaustive runs match the host model,
-    whose never-discovered "linearizable" always-property keeps the default
-    finish_when=ALL policy from stopping at the first discovery."""
-
-    def tensor_properties(self):
-        return super().tensor_properties() + [
-            TensorProperty.sometimes(
-                "unreachable", lambda xp, lanes: lanes[0] != lanes[0]
-            )
-        ]
+from stateright_tpu.models.paxos import (
+    PaxosTensorExhaustive as PaxosTensorFull,
+)
+from stateright_tpu.tensor import TensorModelAdapter
 
 
 def test_c1_twin_matches_host_actor_model():
