@@ -82,6 +82,21 @@ def main() -> None:
         "secs": round(secs7, 3),
         "golden_match": True,
     }
+    # Preliminary line: if a harness timeout cuts the remaining sections,
+    # the last complete line still carries the headline metric.
+    print(
+        json.dumps(
+            {
+                "metric": "2pc-7 exhaustive check, generated states/sec "
+                "(device engine)",
+                "value": round(dev_rate, 1),
+                "unit": "states/sec",
+                "vs_baseline": round(dev_rate / host_rate, 2),
+                "detail": dict(detail, partial=True),
+            }
+        ),
+        flush=True,
+    )
 
     # --- paxos-2: the reference's flagship workload on device -------------
     px = PaxosTensorExhaustive(2)
