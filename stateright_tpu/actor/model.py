@@ -202,22 +202,13 @@ class ActorModel(Model):
         return [state]
 
     def actions(self, state: ActorModelState, actions: List[Any]) -> None:
-        is_ordered = isinstance(self.init_network, Ordered)
-        prev_channel = None
+        # Head-of-channel-only delivery for Ordered networks (model.rs:269-275)
+        # is enforced by Ordered.iter_deliverable itself, which yields exactly
+        # one head envelope per (src, dst) flow.
         for env in state.network.iter_deliverable():
             if self.lossy_network:
                 actions.append(Drop(env))
             if int(env.dst) < len(self.actors):  # ignored if recipient DNE
-                if is_ordered:
-                    # Vestigial parity with model.rs:269-275: our Ordered
-                    # network's iter_deliverable already yields only one head
-                    # envelope per flow, so consecutive envelopes never share
-                    # a channel; kept as defense-in-depth should that
-                    # iterator ever change.
-                    channel = (env.src, env.dst)
-                    if prev_channel == channel:
-                        continue  # queued behind the previous message
-                    prev_channel = channel
                 actions.append(Deliver(env.src, env.dst, env.msg))
 
         for index, timers in enumerate(state.timers_set):

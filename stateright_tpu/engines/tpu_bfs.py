@@ -714,6 +714,11 @@ class TpuBfsChecker(HostEngineBase):
             "qcap": self._qcap,
             "chunk": self._chunk,
             "state_width": self.tm.state_width,
+            # Model identity: a resumed table/ring is only meaningful for
+            # the exact model and property set that produced it; a
+            # same-width different model would silently yield wrong results.
+            "model": f"{type(self.tm).__module__}.{type(self.tm).__qualname__}",
+            "prop_names": [p.name for p in self._tprops],
             "discovery_fps": {
                 k: str(v) for k, v in self._discovery_fps.items()
             },
@@ -748,6 +753,20 @@ class TpuBfsChecker(HostEngineBase):
             raise ValueError(
                 "checkpoint was written with a different queue capacity or "
                 "model encoding; resume with matching engine options"
+            )
+        ckpt_model = meta.get("model")
+        this_model = f"{type(self.tm).__module__}.{type(self.tm).__qualname__}"
+        if ckpt_model is not None and ckpt_model != this_model:
+            raise ValueError(
+                f"checkpoint was written by model {ckpt_model!r}; resuming it "
+                f"with {this_model!r} would silently produce wrong results"
+            )
+        ckpt_props = meta.get("prop_names")
+        this_props = [p.name for p in self._tprops]
+        if ckpt_props is not None and ckpt_props != this_props:
+            raise ValueError(
+                f"checkpoint property set {ckpt_props} does not match this "
+                f"checker's {this_props}; rec_fp/rec_bits would misalign"
             )
         self._tcap = meta["tcap"]
         self._state_count = meta["state_count"]

@@ -50,6 +50,11 @@ struct ActorRt {
   std::mutex mu;
   std::map<uint64_t, double> deadlines;  // key -> absolute deadline (now_s)
   std::thread th;
+  // Owns the fd until a thread takes over (actor_loop closes it on exit);
+  // a partially-constructed runtime therefore releases every socket.
+  ~ActorRt() {
+    if (fd >= 0 && !th.joinable()) close(fd);
+  }
 };
 
 struct Runtime {
@@ -120,6 +125,7 @@ void actor_loop(Runtime* rt, int32_t index) {
            ntohs(src.sin_port), buf.data(), n, 0);
   }
   close(a.fd);
+  a.fd = -1;  // ownership released; ~ActorRt must not close again
 }
 
 }  // namespace

@@ -415,8 +415,17 @@ class ShardedBfsChecker(HostEngineBase):
         counts = np.zeros(N, dtype=np.int64)
         table_np = np.zeros((N, self._tcap, 4), dtype=np.uint32)
         seen = set()
+        owners = h1.astype(np.int64) % N
+        per_owner = np.bincount(owners, minlength=N)
+        if per_owner.max() > self._qcap:
+            raise ValueError(
+                f"shard {int(per_owner.argmax())} would receive "
+                f"{int(per_owner.max())} initial states, exceeding "
+                f"queue_capacity_per_shard={self._qcap}; raise the per-shard "
+                "queue capacity (mirrors the single-device n_init > qcap check)"
+            )
         for i in range(len(inits)):
-            o = int(h1[i]) % N
+            o = int(owners[i])
             fp = combine64(h1[i], h2[i])
             row = queue_np[o, counts[o]]
             row[:S] = inits[i]

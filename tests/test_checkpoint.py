@@ -43,6 +43,28 @@ def test_kill_and_resume_reproduces_golden(tmp_path):
         assert resumed.discovery(name) is not None
 
 
+def test_resume_rejects_wrong_model(tmp_path):
+    """A checkpoint records its model identity; resuming it under a
+    different model (even one sharing state_width) must fail loudly
+    instead of silently reusing the wrong table."""
+    import pytest
+
+    from stateright_tpu.models import IncrementTensor
+
+    ckpt = str(tmp_path / "idmix.ckpt.npz")
+    (
+        TensorModelAdapter(TwoPhaseTensor(4))
+        .checker()
+        .spawn_tpu_bfs(checkpoint_path=ckpt, **OPTS)
+        .join()
+    )
+    # IncrementTensor(1) also encodes into 3 lanes — same state_width.
+    other = TensorModelAdapter(IncrementTensor(1)).checker()
+    assert IncrementTensor(1).state_width == TwoPhaseTensor(4).state_width
+    with pytest.raises(ValueError, match="model"):
+        other.spawn_tpu_bfs(resume_from=ckpt, **OPTS).join()
+
+
 def test_periodic_checkpoint_written(tmp_path):
     ckpt = str(tmp_path / "periodic.ckpt.npz")
     checker = (
