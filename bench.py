@@ -7,9 +7,19 @@ exhaustive 2pc-7 check, device engine, single chip. `vs_baseline` is the
 speedup over the host (Python) oracle engine's states/sec on the same
 model family — the same comparison earlier rounds reported.
 
-The detail block carries the BASELINE.md §"primary metric" measurements:
+Measurement discipline (round 4): every timed device workload runs 3x warm
+and reports the MEDIAN with min/max spread — the reference's bench.sh runs
+each workload 3x for exactly this reason (bench.sh:22-34), and round 3's
+unexplained "regression" turned out to be single-sample noise measured
+with a non-blocking timer (jax.block_until_ready does not block on this
+platform; all timings here are call + host-readback wall time).
+
+The detail block carries the BASELINE.md "primary metric" measurements:
   - paxos-2 device run with the reference golden ASSERTED in-bench
     (16,668 uniques, examples/paxos.rs:327) + its states/sec,
+  - paxos-3 — the BASELINE.json north-star workload — run on device with
+    its host-oracle golden asserted (1,194,428 uniques; the oracle is the
+    same TensorModel through the numpy BFS engine),
   - 2pc-4 device run cross-checked against a LIVE host-oracle run,
   - time-to-first-counterexample on the increment race (device, warm),
   - the 2pc-7 unique count asserted against the host-oracle golden
@@ -20,11 +30,31 @@ time is excluded, as the reference's bench.sh excludes cargo build time.
 """
 
 import json
+import statistics
 import sys
 import time
 
 PAXOS2_GOLDEN = 16_668  # examples/paxos.rs:327
+PAXOS3_GOLDEN = 1_194_428  # host-oracle run of PaxosTensorExhaustive(3)
 TPC7_GOLDEN = 296_447  # host-oracle run of TwoPhaseTensor(7) (this repo)
+
+
+def timed3(mk_checker, golden=None, check=None):
+    """Run a device workload 3x warm; return (median_secs, spread, last)."""
+    secs = []
+    last = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        last = mk_checker().join()
+        secs.append(time.perf_counter() - t0)
+        if golden is not None:
+            assert last.unique_state_count() == golden, (
+                last.unique_state_count(),
+                golden,
+            )
+        if check is not None:
+            assert check(last)
+    return statistics.median(secs), (min(secs), max(secs)), last
 
 
 def main() -> None:
@@ -54,82 +84,99 @@ def main() -> None:
     host4 = TensorModelAdapter(TwoPhaseTensor(4)).checker().spawn_bfs().join()
     tm4 = TwoPhaseTensor(4)
     TensorModelAdapter(tm4).checker().spawn_tpu_bfs().join()  # compile
-    t0 = time.perf_counter()
-    dev4 = TensorModelAdapter(tm4).checker().spawn_tpu_bfs().join()
-    secs4 = time.perf_counter() - t0
-    assert dev4.unique_state_count() == host4.unique_state_count(), (
-        dev4.unique_state_count(),
-        host4.unique_state_count(),
+    med4, spread4, dev4 = timed3(
+        lambda: TensorModelAdapter(tm4).checker().spawn_tpu_bfs(),
+        golden=host4.unique_state_count(),
     )
     detail["tpc4"] = {
-        "states_per_sec": round(dev4.state_count() / secs4, 1),
+        "states_per_sec": round(dev4.state_count() / med4, 1),
         "unique": dev4.unique_state_count(),
         "oracle_match": True,
     }
 
     # --- 2pc-7 headline throughput ----------------------------------------
     tm7 = TwoPhaseTensor(7)
-    opts = dict(chunk_size=8192, queue_capacity=1 << 20, table_capacity=1 << 22)
+    opts = dict(chunk_size=6144, queue_capacity=1 << 20, table_capacity=1 << 22)
     TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts).join()  # compile
-    t0 = time.perf_counter()
-    dev7 = TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts).join()
-    secs7 = time.perf_counter() - t0
-    assert dev7.unique_state_count() == TPC7_GOLDEN, dev7.unique_state_count()
-    dev_rate = dev7.state_count() / secs7
+    med7, spread7, dev7 = timed3(
+        lambda: TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts),
+        golden=TPC7_GOLDEN,
+    )
+    dev_rate = dev7.state_count() / med7
     detail["tpc7"] = {
         "states_per_sec": round(dev_rate, 1),
         "unique": dev7.unique_state_count(),
-        "secs": round(secs7, 3),
+        "secs_median": round(med7, 3),
+        "secs_spread": [round(s, 3) for s in spread7],
         "golden_match": True,
     }
     # Preliminary line: if a harness timeout cuts the remaining sections,
     # the last complete line still carries the headline metric.
-    print(
-        json.dumps(
-            {
-                "metric": "2pc-7 exhaustive check, generated states/sec "
-                "(device engine)",
-                "value": round(dev_rate, 1),
-                "unit": "states/sec",
-                "vs_baseline": round(dev_rate / host_rate, 2),
-                "detail": dict(detail, partial=True),
-            }
-        ),
-        flush=True,
-    )
+    headline = {
+        "metric": "2pc-7 exhaustive check, generated states/sec "
+        "(device engine, median of 3)",
+        "value": round(dev_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(dev_rate / host_rate, 2),
+        "detail": dict(detail, partial=True),
+    }
+    print(json.dumps(headline), flush=True)
 
     # --- paxos-2: the reference's flagship workload on device -------------
     px = PaxosTensorExhaustive(2)
     pxopts = dict(chunk_size=2048, queue_capacity=1 << 18, table_capacity=1 << 20)
     TensorModelAdapter(px).checker().spawn_tpu_bfs(**pxopts).join()  # compile
-    t0 = time.perf_counter()
-    devp = TensorModelAdapter(px).checker().spawn_tpu_bfs(**pxopts).join()
-    secsp = time.perf_counter() - t0
-    assert devp.unique_state_count() == PAXOS2_GOLDEN, devp.unique_state_count()
+    medp, spreadp, devp = timed3(
+        lambda: TensorModelAdapter(px).checker().spawn_tpu_bfs(**pxopts),
+        golden=PAXOS2_GOLDEN,
+    )
     detail["paxos2"] = {
-        "states_per_sec": round(devp.state_count() / secsp, 1),
+        "states_per_sec": round(devp.state_count() / medp, 1),
         "unique": devp.unique_state_count(),
-        "secs": round(secsp, 3),
+        "secs_median": round(medp, 3),
+        "secs_spread": [round(s, 3) for s in spreadp],
         "golden_match": True,
     }
 
     # --- time-to-first-counterexample: increment race (device, warm) ------
     inc = IncrementTensor(2)
     TensorModelAdapter(inc).checker().spawn_tpu_bfs().join()  # compile
-    t0 = time.perf_counter()
-    devi = TensorModelAdapter(inc).checker().spawn_tpu_bfs().join()
-    ttfc = time.perf_counter() - t0
-    assert devi.discovery("fin") is not None
-    detail["ttfc_increment_race_secs"] = round(ttfc, 3)
+    medt, _spreadt, _devi = timed3(
+        lambda: TensorModelAdapter(inc).checker().spawn_tpu_bfs(),
+        check=lambda c: c.discovery("fin") is not None,
+    )
+    detail["ttfc_increment_race_secs"] = round(medt, 3)
 
     result = {
-        "metric": "2pc-7 exhaustive check, generated states/sec (device engine)",
+        "metric": "2pc-7 exhaustive check, generated states/sec "
+        "(device engine, median of 3)",
         "value": round(dev_rate, 1),
         "unit": "states/sec",
         "vs_baseline": round(dev_rate / host_rate, 2),
         "detail": detail,
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
+
+    # --- paxos-3: the BASELINE.json north-star workload -------------------
+    # Run once (compile ~2min + ~35s/run); printed as a refinement of the
+    # same headline so a harness timeout above still leaves a parseable
+    # result.
+    px3 = PaxosTensorExhaustive(3)
+    opts3 = dict(
+        chunk_size=4096, queue_capacity=1 << 20, table_capacity=1 << 26
+    )
+    TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()  # compile
+    t0 = time.perf_counter()
+    d3 = TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()
+    secs3 = time.perf_counter() - t0
+    assert d3.unique_state_count() == PAXOS3_GOLDEN, d3.unique_state_count()
+    detail["paxos3"] = {
+        "states_per_sec": round(d3.state_count() / secs3, 1),
+        "unique": d3.unique_state_count(),
+        "secs": round(secs3, 3),
+        "golden_match": True,
+    }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -76,26 +76,47 @@ P_COUNT = 1  # frontier row count
 P_UNIQUE = 2  # unique states so far
 P_REC = 3  # recorded-discovery bitmask (bit i = property i)
 P_DEPTH_LIMIT = 4
-P_GROW_LIMIT = 5  # gate closes when unique exceeds this
-P_HIGH_WATER = 6  # gate closes when count exceeds this
-P_MAX_STEPS = 7  # fori trip count per block
-P_GEN = 8  # OUT: generated states this block
-P_MAXD = 9  # OUT: max depth seen this block
-P_STEPS = 10  # OUT: gated steps actually executed this block
-P_ERR = 11  # OUT: 1 = probe budget exhausted (table overfull)
-P_TAKE_CAP = 12  # persisted across blocks (self-tuned on rcap overflow)
-P_LEN = 13
+P_GROW_LIMIT = 5  # era exits when unique exceeds this (host grows table)
+P_HIGH_WATER = 6  # era exits when count exceeds this (host spills)
+P_MAX_STEPS = 7  # step budget per era (host polls timeout/targets/ckpt)
+P_GEN = 8  # OUT: generated states this era
+P_MAXD = 9  # OUT: max depth seen this era
+P_STEPS = 10  # OUT: steps actually executed this era
+P_ERR = 11  # IN: pre-existing error (seed unresolved); OUT: >0 = probe budget exhausted
+P_TAKE_CAP = 12  # persisted across eras (self-tuned on rcap overflow)
+P_FIN_ANY = 13  # era exits when (rec & fin_any) != 0
+P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
+P_FIN_ALL_EN = 15
+P_LEN = 16
+
+
+def _rcap(A: int, chunk: int) -> int:
+    """Probe-batch width for the visited-set insert.
+
+    Sized for typical distinct-candidate counts; the take_cap mechanism
+    adapts when a model's step exceeds it. This is a SOUNDNESS-COUPLED
+    constant: the device loop treats it as the overflow threshold while
+    the host sizes grow_limit / pre-growth headroom from it — all sites
+    must use this one definition.
+    """
+    return max(64 * A, (chunk * A) // 8)
 
 
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
-    """Compile the multi-step BFS device loop.
+    """Compile the BFS device "era" loop.
 
     Returns a jitted function
       (table, queue, rec_fp1, rec_fp2, params[P_LEN])
       -> (table, queue, rec_fp1, rec_fp2, params[P_LEN])
-    that runs up to params[P_MAX_STEPS] BFS steps, gating on the host-
-    intervention conditions. `table` is the visited-set lane tuple; `queue`
-    is the ring lane tuple; `params` is the packed scalar vector above.
+    that runs BFS steps in a device-resident `lax.while_loop` until a
+    host-intervention condition closes the gate: frontier exhausted, ring
+    near overflow (host spills), table near full (host grows), step budget
+    reached (host polls timeouts/targets/checkpoints), probe budget
+    exhausted (host raises), or the finish policy's discovery masks are
+    satisfied. One era = ONE dispatch + ONE readback, so a full run that
+    needs no host intervention costs a single ~100ms tunnel round-trip
+    regardless of depth — the decisive constant on this remote-attached
+    platform (see the measured notes below).
     """
     key = (id(tm), chunk, qcap, len(props))
     cached = _LOOP_CACHE.get(key)
@@ -117,9 +138,10 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
     P = len(props)
     eval_and_expand = build_eval_and_expand(tm, props, chunk)
     qmask = qcap - 1
-    # Probe-batch width: sized for typical distinct-candidate counts; the
-    # take_cap mechanism adapts when a model's step exceeds it.
-    rcap = max(64 * A, (chunk * A) // 8)
+    rcap = _rcap(A, chunk)
+    # In-batch dedup scratch: ~2x the candidate width keeps distinct-key
+    # collisions (which retain duplicates, harmlessly) rare.
+    dedup_cap = 1 << max(1, (2 * chunk * A - 1).bit_length())
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def loop(table, queue, rec_fp1, rec_fp2, params):
@@ -132,27 +154,48 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
         grow_limit = params[P_GROW_LIMIT]
         high_water = params[P_HIGH_WATER]
         max_steps = params[P_MAX_STEPS]
-        # The outer loop is a COUNTED fori_loop, not a data-dependent
-        # while_loop: on a remote-attached TPU a top-level while predicate is
-        # fetched by the host every iteration (~100-200ms round-trip each),
-        # whereas a counted loop runs entirely on-device. Host-intervention
-        # conditions become a gate predicate inside the body — once it goes
-        # false, remaining iterations are masked no-ops (take = 0, so every
-        # effect is disabled); the host reads the exit state after the block.
+        fin_any = params[P_FIN_ANY]
+        fin_all = params[P_FIN_ALL]
+        fin_all_en = params[P_FIN_ALL_EN]
+        # The era is a data-dependent `lax.while_loop` whose predicate runs
+        # ON DEVICE (measured round 4: a jitted while predicate costs
+        # nothing extra — the old belief that it forced a host round-trip
+        # per iteration only holds for NON-jitted top-level loops). This
+        # matters doubly here: (a) no wasted gated no-op iterations (every
+        # iteration costs nearly a full step's gather traffic whether or
+        # not it does work), and (b) no per-block dispatch+readback
+        # (~350-400ms measured) for the progressive block ramp the old
+        # design needed.
         #
         # Inside the body only uint32 sum-reduction chains may feed values
-        # that GATE the next iteration (count/unique-style); a gate routed
-        # through a boolean any()-derived carry serializes the pipeline at
-        # ~1.5s per step (measured), as do reduction -> broadcast ->
+        # that GATE the next iteration (count/unique/rec_acc-style); a gate
+        # routed through a boolean any()-derived carry serializes the
+        # pipeline (~1.5s/step measured), as do reduction -> broadcast ->
         # reduction chains anywhere in the carry (argmax selects, one-hot
-        # extractions, max reduces) at ~200ms per iteration. Discovery
-        # fingerprints are therefore accumulated as per-position lane
-        # snapshots (first hit per position wins, pure elementwise) and
-        # extracted once AFTER the loop; discoveries and insert errors do
-        # NOT close the gate — the host acts on them at block granularity,
-        # exactly like the reference's between-block finish checks
-        # (bfs.rs:134-144).
-        def body(_i, carry):
+        # extractions, max reduces). Discovery fingerprints are therefore
+        # accumulated as per-position lane snapshots (first hit per
+        # position wins, pure elementwise) and extracted once AFTER the
+        # loop; only the scalar discovery BITS (via per-property uint32
+        # sums) feed the gate, implementing the finish policy's early exit
+        # on device (reference bfs.rs:134-144 checks between blocks).
+        def cond(carry):
+            (
+                _table, _queue, _head, count, unique, _gen, steps,
+                err_cnt, _take_cap, rec_acc, _hseen, _f1, _f2, _fd,
+            ) = carry
+            fin_hit = ((rec_acc & fin_any) != u(0)) | (
+                (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
+            )
+            return (
+                (count > u(0))
+                & (count <= high_water)
+                & (unique <= grow_limit)
+                & (steps < max_steps)
+                & (err_cnt == u(0))
+                & ~fin_hit
+            )
+
+        def body(carry):
             (
                 table,
                 queue,
@@ -163,17 +206,13 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
                 steps,
                 err_cnt,
                 take_cap,
+                rec_acc,
                 hseen,
                 facc1,
                 facc2,
                 faccd,
             ) = carry
-            pred = (
-                (count > 0) & (count <= high_water) & (unique <= grow_limit)
-            )
-            take = jnp.where(
-                pred, jnp.minimum(jnp.minimum(count, u(chunk)), take_cap), u(0)
-            )
+            take = jnp.minimum(jnp.minimum(count, u(chunk)), take_cap)
             active = jnp.arange(chunk, dtype=jnp.uint32) < take
             popped, _idx = fr.ring_gather(queue, head, chunk)
             rows = popped[:S]
@@ -187,11 +226,13 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             )
             # In-batch pre-dedup: only first occurrences probe the visited
             # table, and the insert probes a compacted [rcap] batch. On this
-            # platform probe gathers cost time proportional to their WIDTH
-            # (~40ns/element regardless of index locality), so probe traffic
-            # must scale with the number of distinct candidates, not the
-            # padded C*A batch width.
-            reps = fr.dedup_mask(ex.h1, ex.h2, ex.valid)
+            # platform dependent probe gathers are the dominant per-step
+            # cost (latency-bound; ~65ns/element at these widths), so probe
+            # traffic must scale with the number of distinct candidates,
+            # not the padded C*A batch width. The dedup itself is the cheap
+            # claim-based pass (approximate; the insert arbitrates
+            # leftovers exactly).
+            reps = fr.claim_dedup(ex.h1, ex.h2, ex.valid, dedup_cap)
             table, is_new, unresolved, n_ovf = vs.insert(
                 table, ex.h1, ex.h2, ex.parent1, ex.parent2, reps, rcap=rcap
             )
@@ -214,7 +255,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             count = count - consumed + new_count
             unique = unique + new_count
             gen = gen + jnp.where(ovf, u(0), ex.generated)
-            steps = steps + (pred & ~ovf).astype(jnp.uint32)
+            steps = steps + (~ovf).astype(jnp.uint32)
             take_cap = jnp.where(
                 ovf,
                 jnp.maximum(take >> u(1), u(1)),
@@ -233,6 +274,11 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
                     facc2_n.append(jnp.where(first, row_h2, facc2[i]))
                     faccd_n.append(jnp.where(first, depth, faccd[i]))
                     hseen_n.append(hseen[i] | hits)
+                    # Scalar discovery bit for the gate: a uint32 sum (NOT
+                    # a boolean any()) so the carry stays on the fast path.
+                    rec_acc = rec_acc | (
+                        jnp.minimum(hits.sum(dtype=jnp.uint32), u(1)) << u(i)
+                    )
                 hseen = tuple(hseen_n)
                 facc1 = tuple(facc1_n)
                 facc2 = tuple(facc2_n)
@@ -248,6 +294,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
                 steps,
                 err_cnt,
                 take_cap,
+                rec_acc,
                 hseen,
                 facc1,
                 facc2,
@@ -263,9 +310,11 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             count0,
             unique0,
             u(0),  # generated delta
-            u(0),  # steps actually executed (gate was open)
-            u(0),  # unresolved-insert count (checked at block end)
+            u(0),  # steps executed
+            params[P_ERR],  # unresolved-insert count (gates the era closed;
+            # nonzero input = a seeding-time error surfacing on first read)
             jnp.minimum(jnp.maximum(params[P_TAKE_CAP], u(1)), u(chunk)),
+            rec_bits,  # scalar discovery bits accumulated for the fin gate
             tuple(false_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
@@ -281,11 +330,12 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             steps,
             err_cnt,
             take_cap_out,
+            _rec_acc,
             hseen,
             facc1,
             facc2,
             faccd,
-        ) = lax.fori_loop(jnp.uint32(0), max_steps, body, init)
+        ) = lax.while_loop(cond, body, init)
 
         # Block-level epilogue (runs ONCE per block, outside the loop, where
         # argmax / dynamic gathers are cheap): extract discovery fingerprints
@@ -327,12 +377,70 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
                 steps,
                 (err_cnt > 0).astype(u),
                 take_cap_out,
+                fin_any,
+                fin_all,
+                fin_all_en,
             ]
         )
         return table, queue, rec_fp1, rec_fp2, params_out
 
     _LOOP_CACHE[key] = (tm, loop)
     return loop
+
+
+_SEED_CACHE: Dict[Tuple, Any] = {}
+
+
+def _build_seed(S: int, qcap: int, tcap: int):
+    """Compile the one-dispatch run seeder.
+
+    Takes (qinit[W, n_init], params[P_LEN]) and returns (table, queue,
+    params_out): fresh table/ring created ON DEVICE, init fingerprints
+    claim-inserted (duplicate inits resolve exactly like the reference's
+    bfs.rs:76-82 — all rows enqueue, the table keeps one), and the packed
+    params filled in (count, unique, err). The output params feed the
+    first era directly, so a run starts with ONE upload (qinit+params) and
+    needs NO seed-time download — on this platform every host<->device
+    sync costs a ~100ms round-trip, and the old eager seed path paid three.
+    """
+    key = (S, qcap, tcap)
+    cached = _SEED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    while len(_SEED_CACHE) >= 16:
+        _SEED_CACHE.pop(next(iter(_SEED_CACHE)))
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import visited_set as vs
+
+    W = S + 4
+
+    @jax.jit
+    def seed(qinit, params):
+        u = jnp.uint32
+        n_init = qinit.shape[1]
+        table = tuple(jnp.zeros(tcap, dtype=jnp.uint32) for _ in range(4))
+        zero = jnp.zeros(n_init, dtype=jnp.uint32)
+        table, is_new, unresolved, _ovf = vs.insert(
+            table, qinit[S], qinit[S + 1], zero, zero,
+            jnp.ones(n_init, bool),
+        )
+        queue = tuple(
+            jnp.zeros(qcap, dtype=jnp.uint32).at[:n_init].set(qinit[i])
+            for i in range(W)
+        )
+        params_out = (
+            params.at[P_HEAD].set(u(0))
+            .at[P_COUNT].set(u(n_init))
+            .at[P_UNIQUE].set(is_new.sum(dtype=u))
+            .at[P_ERR].set(unresolved.sum(dtype=u))
+        )
+        return table, queue, params_out
+
+    _SEED_CACHE[key] = seed
+    return seed
 
 
 class TpuBfsChecker(HostEngineBase):
@@ -349,7 +457,7 @@ class TpuBfsChecker(HostEngineBase):
         chunk_size: int = 8192,
         queue_capacity: int = 1 << 20,
         table_capacity: int = 1 << 22,
-        sync_steps: int = 512,
+        sync_steps: int = 4096,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: Optional[float] = None,
         resume_from: Optional[str] = None,
@@ -432,12 +540,35 @@ class TpuBfsChecker(HostEngineBase):
         P = len(self._tprops)
         W = S + 4  # queue lanes: state | h1 | h2 | ebits | depth
 
+        depth_limit = (
+            self._target_max_depth
+            if self._target_max_depth is not None
+            else 0xFFFFFFFF
+        )
+        high_water = self._qcap - C * A
+        # Era budget: the device loop exits by itself on every meaningful
+        # condition (empty frontier, spill, grow, discovery-finish, probe
+        # error); the step budget only exists so the host can poll wall-
+        # clock concerns — timeouts and checkpoint cadence — at bounded
+        # granularity. Unbudgeted runs use the full sync_steps allowance.
+        max_sync = (
+            self._max_sync_steps
+            if self._timeout is None and self._ckpt_every is None
+            else min(64, self._max_sync_steps)
+        )
+        # Finish-policy discovery masks for the device-side early exit.
+        fin_any, fin_all, fin_all_en = self._finish_when.device_masks(
+            self._tprops
+        )
+        params_dev = None
+        last_max_steps = None
+        take_cap = self._chunk
+
         _dbg("run: encoding inits")
         if self._resume_from is not None:
             table, queue, head, count, rec_bits, rec_fp1, rec_fp2 = (
                 self._load_checkpoint(self._resume_from, W)
             )
-            n_init = 1  # resume: counters restored by the loader
         else:
             inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
             init_lanes = tuple(inits[:, i] for i in range(S))
@@ -451,11 +582,15 @@ class TpuBfsChecker(HostEngineBase):
                 return
             if n_init > self._qcap:
                 raise ValueError("more initial states than queue capacity")
+            rcap = _rcap(A, C)
+            while n_init + rcap > vs.MAX_LOAD * self._tcap:
+                self._tcap *= 2
 
-            # Seed the table with init fingerprints (parent sentinel (0,0)).
-            # The claim protocol in vs.insert resolves duplicate init states.
-            # All init data crosses to the device in ONE upload (each individual
-            # transfer costs a ~100ms round-trip on a remote-attached device).
+            # One upload (qinit rows + params template), zero downloads: the
+            # jitted seeder builds the table/ring on device, claim-inserts
+            # the init fingerprints (dup inits resolve like bfs.rs:76-82;
+            # all rows enqueue), and fills count/unique/err into the packed
+            # params, which feed the first era dispatch directly.
             h1, h2 = hash_words_np(inits)
             qinit = np.zeros((W, n_init), dtype=np.uint32)
             qinit[:S] = inits.T
@@ -463,68 +598,41 @@ class TpuBfsChecker(HostEngineBase):
             qinit[S + 1] = h2
             qinit[S + 2] = self._init_ebits_tensor
             qinit[S + 3] = 1
-            qinit_dev = jnp.asarray(qinit)  # the one upload
 
-            _dbg("run: seeding table")
-            table = vs.empty_table(self._tcap)
-            zero = jnp.zeros(n_init, dtype=jnp.uint32)
-            table, is_new, unresolved, _ovf = vs.insert_jit(
-                table,
-                qinit_dev[S],
-                qinit_dev[S + 1],
-                zero,
-                zero,
-                jnp.ones(n_init, bool),
-            )
-            stats = np.asarray(
-                jnp.stack(
-                    [is_new.sum(dtype=jnp.uint32), unresolved.sum(dtype=jnp.uint32)]
+            max_steps0 = max_sync
+            if self._target_state_count is not None:
+                remaining = max(0, self._target_state_count - n_init)
+                max_steps0 = max(
+                    1, min(max_steps0, 1 + remaining // max(1, C * A))
                 )
-            )  # one download
-            assert int(stats[1]) == 0
-            self._unique = int(stats[0])
-
-            # Queue lanes: [state lanes | h1 | h2 | ebits | depth]. All init rows
-            # are enqueued, dups included (reference bfs.rs:76-82).
-            queue = tuple(
-                jnp.zeros(self._qcap, dtype=jnp.uint32).at[:n_init].set(qinit_dev[i])
-                for i in range(W)
+            template = np.zeros(P_LEN, dtype=np.uint32)
+            template[P_DEPTH_LIMIT] = depth_limit
+            template[P_HIGH_WATER] = high_water
+            template[P_MAX_STEPS] = max_steps0
+            template[P_TAKE_CAP] = take_cap
+            template[P_FIN_ANY] = fin_any
+            template[P_FIN_ALL] = fin_all
+            template[P_FIN_ALL_EN] = fin_all_en
+            template[P_GROW_LIMIT] = max(
+                0, int(vs.MAX_LOAD * self._tcap) - rcap
             )
-            _dbg("run: seeded; entering block loop")
+
+            _dbg("run: dispatching seeder")
+            seed = _build_seed(S, self._qcap, self._tcap)
+            table, queue, params_dev = seed(
+                jnp.asarray(qinit), jnp.asarray(template)
+            )
             head = 0
             count = n_init
-
-        depth_limit = (
-            self._target_max_depth
-            if self._target_max_depth is not None
-            else 0xFFFFFFFF
-        )
-        high_water = self._qcap - C * A
+            # Provisional (exact unless dup inits); corrected at first read.
+            self._unique = n_init
+            last_max_steps = max_steps0
+            _dbg("run: seeded; entering era loop")
 
         if self._resume_from is None:
             rec_bits = 0
             rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
             rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
-
-        # Progressive block sizing: gated no-op iterations still pay the
-        # width-proportional sort/compaction (~15ms each), so blocks start
-        # short and double while the search keeps saturating them — big runs
-        # converge to the full budget, small runs never overpay. A
-        # frontier-based floor (2 * count/chunk) lets deep frontiers jump
-        # straight to long blocks without waiting out the ramp.
-        sync_steps = 4
-        max_sync = (
-            self._max_sync_steps
-            if self._timeout is None
-            else min(64, self._max_sync_steps)
-        )
-        # Packed-params passthrough: when the host changed nothing since the
-        # last block, the loop's own output params feed straight back in —
-        # zero uploads (each individual transfer costs a ~100ms round-trip
-        # on a remote-attached device).
-        params_dev = None
-        last_max_steps = None
-        take_cap = self._chunk
 
         while count > 0 or self._spill:
             host_dirty = params_dev is None
@@ -549,17 +657,13 @@ class TpuBfsChecker(HostEngineBase):
             # Proactive growth: guarantee the worst-case insert batch keeps
             # the load factor under vs.MAX_LOAD, so probe budgets can't be
             # exhausted (exhaustion would silently drop states).
-            rcap = max(64 * A, (C * A) // 8)
+            rcap = _rcap(A, C)
             while self._unique + rcap > vs.MAX_LOAD * self._tcap:
                 table, self._tcap = self._grow_table(table)
                 host_dirty = True
             grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - rcap)
 
-            # Quantize the frontier-based floor to a power of two so
-            # max_steps pins between blocks and the params passthrough stays
-            # upload-free (a changed max_steps forces a ~100ms re-upload).
-            floor = 2 * ((count + C - 1) // C)
-            max_steps = min(max_sync, max(sync_steps, 1 << (floor - 1).bit_length() if floor > 1 else 1))
+            max_steps = max_sync
             if self._target_state_count is not None:
                 # Bound overshoot past the state-count target: each step
                 # generates at most C*A states.
@@ -585,6 +689,9 @@ class TpuBfsChecker(HostEngineBase):
                             0,
                             0,
                             take_cap,
+                            fin_any,
+                            fin_all,
+                            fin_all_en,
                         ],
                         dtype=np.uint32,
                     )
@@ -619,8 +726,6 @@ class TpuBfsChecker(HostEngineBase):
             self._unique = int(vals[2])
             self._state_count += int(vals[8])
             self._max_depth = max(self._max_depth, int(vals[9]))
-            if int(vals[10]) >= max_steps:
-                sync_steps = min(sync_steps * 2, max_sync)
             # Record first discovery per property (reference races are
             # benign; ours are deterministic per compiled program).
             new_bits = int(vals[3])
@@ -718,6 +823,7 @@ class TpuBfsChecker(HostEngineBase):
             # the exact model and property set that produced it; a
             # same-width different model would silently yield wrong results.
             "model": f"{type(self.tm).__module__}.{type(self.tm).__qualname__}",
+            "model_config": self.tm.config_digest(),
             "prop_names": [p.name for p in self._tprops],
             "discovery_fps": {
                 k: str(v) for k, v in self._discovery_fps.items()
@@ -760,6 +866,14 @@ class TpuBfsChecker(HostEngineBase):
             raise ValueError(
                 f"checkpoint was written by model {ckpt_model!r}; resuming it "
                 f"with {this_model!r} would silently produce wrong results"
+            )
+        ckpt_cfg = meta.get("model_config")
+        this_cfg = self.tm.config_digest()
+        if ckpt_cfg is not None and ckpt_cfg != this_cfg:
+            raise ValueError(
+                f"checkpoint was written with model config {ckpt_cfg!r}; this "
+                f"instance has {this_cfg!r} — same-width different-parameter "
+                "models must not share a visited table"
             )
         ckpt_props = meta.get("prop_names")
         this_props = [p.name for p in self._tprops]

@@ -57,6 +57,40 @@ class HasDiscoveries:
             return any(name in discoveries for name in self._names)
         raise ValueError(f"unknown finish policy {kind!r}")
 
+    def device_masks(self, properties: List):
+        """Lower this policy to property-index bitmasks for device gates.
+
+        Returns (any_mask, all_mask, all_enabled): the policy matches a
+        discovery bitmask `rec` iff `(rec & any_mask) != 0 or
+        (all_enabled and (rec & all_mask) == all_mask)` — exactly
+        `matches()` over index bitmaps. When the policy references a
+        property name that does not exist (an `all_of` that can never
+        complete), the all-gate is disabled so the device never exits
+        early on it; the host-side `matches()` stays authoritative.
+        """
+        idx = {p.name: i for i, p in enumerate(properties)}
+        all_bits = (1 << len(properties)) - 1
+        failure_bits = 0
+        for i, p in enumerate(properties):
+            if p.expectation.discovery_is_failure:
+                failure_bits |= 1 << i
+        kind = self._kind
+        if kind == "all":
+            return 0, all_bits, 1
+        if kind == "any":
+            return all_bits, 0, 0
+        if kind == "any_failures":
+            return failure_bits, 0, 0
+        if kind == "all_failures":
+            return 0, failure_bits, 1
+        if kind == "all_of":
+            if not all(n in idx for n in self._names):
+                return 0, 0, 0  # can never match; disable the device gate
+            return 0, sum(1 << idx[n] for n in self._names), 1
+        if kind == "any_of":
+            return sum(1 << idx[n] for n in self._names if n in idx), 0, 0
+        raise ValueError(f"unknown finish policy {kind!r}")
+
     def __repr__(self) -> str:
         if self._names:
             return f"HasDiscoveries.{self._kind}({sorted(self._names)})"

@@ -46,14 +46,20 @@ using srn_event_cb = void (*)(void* ctx, int32_t actor, int32_t kind,
                               const uint8_t* data, int64_t len, uint64_t key);
 
 struct ActorRt {
-  int fd = -1;
+  // Atomic: concurrent readers (srn_send) may race the bind-failure
+  // writer. Relaxed ordering suffices — the value is only a descriptor
+  // number. The descriptor is closed ONLY here, after srn_stop has joined
+  // the actor thread (or for a thread that never started) — closing it
+  // earlier would let the kernel reuse the number while a concurrent
+  // srn_send still holds it, silently writing through an unrelated
+  // descriptor.
+  std::atomic<int> fd{-1};
   std::mutex mu;
   std::map<uint64_t, double> deadlines;  // key -> absolute deadline (now_s)
   std::thread th;
-  // Owns the fd until a thread takes over (actor_loop closes it on exit);
-  // a partially-constructed runtime therefore releases every socket.
   ~ActorRt() {
-    if (fd >= 0 && !th.joinable()) close(fd);
+    int f = fd.load(std::memory_order_relaxed);
+    if (f >= 0) close(f);
   }
 };
 
@@ -79,6 +85,7 @@ constexpr int kStopPollMs = 50;     // stop-flag responsiveness bound
 
 void actor_loop(Runtime* rt, int32_t index) {
   ActorRt& a = *rt->actors[index];
+  const int fd = a.fd.load(std::memory_order_relaxed);
   rt->cb(rt->ctx, index, kEventStart, 0, 0, nullptr, 0, 0);
 
   std::vector<uint8_t> buf(kRecvBuf);
@@ -112,20 +119,20 @@ void actor_loop(Runtime* rt, int32_t index) {
       if (wait < timeout_ms) timeout_ms = wait < 1 ? 1 : (int)wait;
     }
     struct pollfd pfd;
-    pfd.fd = a.fd;
+    pfd.fd = fd;
     pfd.events = POLLIN;
     int rc = poll(&pfd, 1, timeout_ms);
     if (rc <= 0 || !(pfd.revents & POLLIN)) continue;
     sockaddr_in src{};
     socklen_t srclen = sizeof(src);
-    ssize_t n = recvfrom(a.fd, buf.data(), buf.size(), 0,
+    ssize_t n = recvfrom(fd, buf.data(), buf.size(), 0,
                          reinterpret_cast<sockaddr*>(&src), &srclen);
     if (n <= 0) continue;
     rt->cb(rt->ctx, index, kEventMsg, ntohl(src.sin_addr.s_addr),
            ntohs(src.sin_port), buf.data(), n, 0);
   }
-  close(a.fd);
-  a.fd = -1;  // ownership released; ~ActorRt must not close again
+  // The descriptor stays open (and a.fd set) until ~ActorRt runs after
+  // srn_stop joins this thread — see the lifecycle note on ActorRt.
 }
 
 }  // namespace
@@ -150,7 +157,7 @@ int64_t srn_start(const uint32_t* ips, const uint16_t* ports, int32_t n,
     addr.sin_addr.s_addr = htonl(ips[i]);
     addr.sin_port = htons(ports[i]);
     if (bind(a->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
-      close(a->fd);
+      // ~ActorRt releases this socket (and rt's destructor the others).
       return -1 - i;
     }
     rt->actors.push_back(std::move(a));
@@ -177,8 +184,10 @@ void srn_send(int64_t handle, int32_t actor, uint32_t dst_ip,
   addr.sin_addr.s_addr = htonl(dst_ip);
   addr.sin_port = htons(dst_port);
   // Fire-and-forget (spawn.rs:188-196): errors intentionally ignored.
-  sendto(rt->actors[actor]->fd, data, (size_t)len, 0,
-         reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  int fd = rt->actors[actor]->fd.load(std::memory_order_relaxed);
+  if (fd < 0) return;  // actor already shut down
+  sendto(fd, data, (size_t)len, 0, reinterpret_cast<sockaddr*>(&addr),
+         sizeof(addr));
 }
 
 void srn_set_deadline(int64_t handle, int32_t actor, uint64_t key,

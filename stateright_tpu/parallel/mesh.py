@@ -87,6 +87,9 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     eval_and_expand = build_eval_and_expand(tm, props, chunk)
     qmask = qcap - 1
     X = S + 6  # exchanged lanes: state | h1 | h2 | p1 | p2 | ebits | depth
+    # In-batch dedup scratch (per shard): ~2x candidate width keeps
+    # distinct-key collisions (which harmlessly retain duplicates) rare.
+    dedup_cap = 1 << max(1, (2 * chunk * tm.max_actions - 1).bit_length())
 
     def per_device(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
@@ -146,7 +149,10 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
 
             # In-batch dedup before the exchange: only first occurrences
             # travel (duplicates would just lose the claim at the owner).
-            reps = fr.dedup_mask(ex.h1, ex.h2, ex.valid)
+            # Claim-based and approximate — a scratch collision lets both
+            # copies travel, and the owner's insert arbitrates exactly; the
+            # lexsort this replaces dominated the per-step cost.
+            reps = fr.claim_dedup(ex.h1, ex.h2, ex.valid, dedup_cap)
             owner = ex.h1 % u(n_shards)
 
             # Bucket by owner into [n_shards * quota] send lanes.

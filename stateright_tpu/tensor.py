@@ -104,6 +104,23 @@ class TensorModel:
 
     # -- derived ------------------------------------------------------------
 
+    def config_digest(self) -> str:
+        """Stable digest of this instance's constructor-derived parameters.
+
+        Binds checkpoints to the exact model configuration: two instances of
+        the same class with different parameters that happen to share
+        state_width would otherwise pass resume validation and silently
+        reuse the wrong visited table. Default: every scalar/tuple attribute
+        in declaration-independent (sorted) order; models holding richer
+        config may override.
+        """
+        items = sorted(
+            (k, v)
+            for k, v in vars(self).items()
+            if isinstance(v, (bool, int, float, str, tuple))
+        )
+        return repr(items)
+
     def fingerprint_row(self, row: np.ndarray) -> int:
         h1, h2 = hash_words_np(np.asarray(row, dtype=np.uint32)[None, :])
         return combine64(h1[0], h2[0])
