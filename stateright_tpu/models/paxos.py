@@ -17,10 +17,12 @@ invoked (the tester's real-time edges, linearizability.rs:55-66). All of
 those are lanes here, so unique-state counts agree with the host model
 (16,668 at 2 clients / 3 servers, examples/paxos.rs:327).
 
-The "value chosen" sometimes-property runs on device. The "linearizable"
-always-property is NOT evaluated on device (its backtracking serialization
-search stays host-side; run the host model to check it) — omitting a
-never-failing always-property does not change the explored state space.
+BOTH properties run on device: "value chosen" (sometimes) scans the net
+for a value-carrying GetOk, and "linearizable" (always) evaluates the
+register-linearizability verdict per state as a closed-form lane program
+(write-precedence digraph acyclicity — see `linearizable_lanes`), matching
+the host model's backtracking-tester verdict (examples/paxos.rs:282-284
+parity; oracle-validated in tests/test_paxos_linearizable.py).
 
 Lane layout (S = 6 + c + K lanes, K = 14*c network slots):
   lanes 0..5   server j: [2j] packed core, [2j+1] prepares map
@@ -433,6 +435,89 @@ class PaxosTensor(TensorModel):
 
     # -- properties ---------------------------------------------------------
 
+    def linearizable_lanes(self, xp, lanes):
+        """Batched register-linearizability verdict from the client lanes.
+
+        The general tester backtracks (linearizability.rs:120-181), but THIS
+        workload admits an exact closed form: every client invokes its
+        (unique-valued) write at time zero and reads only after its own
+        write completes, so a linearization exists iff an ordering σ of the
+        c writes satisfies, for every COMPLETED read_j returning value k_j:
+
+          - gap placement: read_j sits immediately after write_{k_j} in σ
+            (reads impose no other register constraint),
+          - its own write precedes it:            j     <σ k_j,
+          - every write completed before read_j
+            was invoked (counter c_ij >= 1):      i     <σ k_j,
+          - every read completed before read_j
+            was invoked (counter c_ij == 2):      k_i   <σ k_j
+            (strict between distinct writes; same-gap reads order freely).
+
+        All constraints are binary precedences over c nodes, so existence
+        is ACYCLICITY of the induced digraph — evaluated here as pure
+        elementwise lane arithmetic (adjacency bitmask rows + log-depth
+        transitive closure), the shape the device engine needs. A completed
+        read returning None is impossible in any linearization (the
+        client's own write precedes it) and fails directly.
+
+        Validated state-for-state against a brute-force over all c!
+        serializations (tests/test_paxos_linearizable.py) and against the
+        host engines on the reachable space.
+        """
+        u = xp.uint32
+        c = self.c
+        cl = [lanes[6 + i] for i in range(c)]
+        phase = [cl[i] & u(3) for i in range(c)]
+        val = [(cl[i] >> u(2)) & u(15) for i in range(c)]
+        done = [phase[i] == u(2) for i in range(c)]
+        kk = [(val[i] - u(2)) & u(15) for i in range(c)]  # writer index read
+
+        false_ = lanes[0] != lanes[0]
+        none_read = false_
+        zero = u(0) * lanes[0]
+        adj = [zero for _ in range(c)]  # bit t of adj[r]: edge r -> t
+
+        def set_edge(row_static, tgt, cond):
+            # adj[row] |= (1 << tgt) where cond and tgt != row (data shift).
+            e = xp.where(
+                cond & (tgt != u(row_static)), u(1) << tgt, zero
+            )
+            adj[row_static] = adj[row_static] | e
+
+        for j in range(c):
+            rj = done[j]
+            none_read = none_read | (rj & (val[j] == u(1)))
+            set_edge(j, kk[j], rj)  # own write precedes own read
+            for i in range(c):
+                if i == j:
+                    continue
+                cij = (cl[j] >> u(6 + 2 * i)) & u(3)
+                # write_i completed before read_j invoked
+                set_edge(i, kk[j], rj & (cij >= u(1)))
+                # read_i completed before read_j invoked: k_i -> k_j
+                rr = rj & (cij == u(2))
+                for r in range(c):
+                    set_edge(r, kk[j], rr & (kk[i] == u(r)))
+
+        # Transitive closure by repeated relaxation (c <= 7 => 3 rounds of
+        # row-OR reach fixpoint: path lengths double each round).
+        rounds = max(1, (c - 1).bit_length())
+        for _ in range(rounds):
+            nxt = list(adj)
+            for i in range(c):
+                acc = nxt[i]
+                for k in range(c):
+                    acc = acc | xp.where(
+                        ((adj[i] >> u(k)) & u(1)) == u(1), adj[k], zero
+                    )
+                nxt[i] = acc
+            adj = nxt
+
+        cyclic = false_
+        for i in range(c):
+            cyclic = cyclic | (((adj[i] >> u(i)) & u(1)) == u(1))
+        return ~(cyclic | none_read)
+
     def tensor_properties(self) -> List[TensorProperty]:
         NB = self._net_base
         K = self.K
@@ -447,7 +532,10 @@ class PaxosTensor(TensorModel):
                 acc = acc | (is_gok & (val != u(1)))
             return acc
 
-        return [TensorProperty.sometimes("value chosen", value_chosen)]
+        return [
+            TensorProperty.always("linearizable", self.linearizable_lanes),
+            TensorProperty.sometimes("value chosen", value_chosen),
+        ]
 
     # -- display ------------------------------------------------------------
 
@@ -495,16 +583,11 @@ class PaxosTensor(TensorModel):
 
 
 class PaxosTensorExhaustive(PaxosTensor):
-    """PaxosTensor plus an unreachable sometimes-property.
+    """Compatibility alias from rounds 1-3.
 
-    The host model's never-discovered "linearizable" always-property keeps
-    the default finish_when=ALL policy exploring to exhaustion; this twin
-    needs an equivalent blocker so exhaustive runs match the host goldens.
+    Historically PaxosTensor lacked the "linearizable" always-property on
+    device, so exhaustive runs needed an extra never-satisfied blocker
+    here. Now that "linearizable" is evaluated on device (never violated,
+    so the default finish_when=ALL explores to exhaustion exactly like the
+    host model), the base class already has the right behavior.
     """
-
-    def tensor_properties(self):
-        return super().tensor_properties() + [
-            TensorProperty.sometimes(
-                "unreachable", lambda xp, lanes: lanes[0] != lanes[0]
-            )
-        ]
