@@ -634,19 +634,38 @@ class TpuBfsChecker(HostEngineBase):
             rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
             rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
 
+        # Spill hysteresis: drain down to / refill up to this margin below
+        # high_water, so a spilling run still gets long eras between host
+        # round-trips instead of bouncing on the watermark (see the drain
+        # note below). Guaranteed >= one block of room: qcap >= 2*C*A.
+        spill_target = max(high_water // 2, high_water - 64 * C * A)
+
         while count > 0 or self._spill:
             host_dirty = params_dev is None
             # Refill from host spill, leaving room for the worst-case append
-            # (count must stay <= high_water going into the loop, or the ring
-            # append could wrap over unconsumed frontier rows).
-            while self._spill and count + len(self._spill[-1]) <= high_water:
-                rows = self._spill.pop()
+            # (count must stay <= high_water going into the loop, or the
+            # ring append could wrap over unconsumed frontier rows; the
+            # margin below keeps refills from re-crossing the line
+            # immediately). An empty frontier always refills at least one
+            # block (a block is <= C*A <= high_water), so spill can't
+            # strand.
+            refill = []
+            refill_rows = 0
+            while self._spill and (
+                count + refill_rows + len(self._spill[-1]) <= spill_target
+                or (count == 0 and not refill)
+            ):
+                refill.append(self._spill.pop())
+                refill_rows += len(refill[-1])
+            if refill:
+                rows = np.concatenate(refill, axis=0)
                 k = len(rows)
                 tail_idx = jnp.asarray(
                     (head + count + np.arange(k)) & (self._qcap - 1)
                 )
+                rows_dev = jnp.asarray(rows)  # ONE upload for all blocks
                 queue = tuple(
-                    queue[i].at[tail_idx].set(jnp.asarray(rows[:, i]))
+                    queue[i].at[tail_idx].set(rows_dev[:, i])
                     for i in range(W)
                 )
                 count += k
@@ -737,25 +756,32 @@ class TpuBfsChecker(HostEngineBase):
                         self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
                 rec_bits = new_bits
 
-            # Spill if the next chunk could overflow the ring.
-            while count > high_water:
-                k = min(C * A, count - high_water)
+            # Spill if the next chunk could overflow the ring. Drain to the
+            # MARGIN below the watermark, not just to it: draining only the
+            # overhang lets the very next era re-cross the line after a few
+            # steps, thrashing spill round-trips (measured on ABD c=4:
+            # 2-3 useful steps per ~7s spill cycle). The margin trades one
+            # bigger drain for eras long enough to amortize it.
+            if count > high_water:
+                k = count - spill_target
                 take_idx = jnp.asarray(
                     (head + count - k + np.arange(k)) & (self._qcap - 1)
                 )
-                block = np.stack(
-                    [np.asarray(queue[i][take_idx]) for i in range(W)], axis=1
+                # Stack on device, download ONCE (per-lane downloads cost a
+                # ~100ms round-trip each on this platform).
+                big = np.asarray(
+                    jnp.stack([queue[i][take_idx] for i in range(W)], axis=1)
                 )
-                self._spill.append(block)
+                # Keep blocks refill-sized so partial refills stay possible.
+                for off in range(0, k, C * A):
+                    self._spill.append(big[off : off + C * A])
                 count -= k
                 # Refills can place these rows after deeper children, breaking
                 # the ring's depth monotonicity that the block-level maxd read
                 # relies on — fold their depth in here. (Counts rows that are
                 # guaranteed to be visited unless the run stops early; a rare
                 # slight over-report beats a systematic under-report.)
-                self._max_depth = max(
-                    self._max_depth, int(block[:, S + 3].max())
-                )
+                self._max_depth = max(self._max_depth, int(big[:, S + 3].max()))
                 params_dev = None  # host-side count changed; force re-upload
 
             if self._ckpt_path is not None and (

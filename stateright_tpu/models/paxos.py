@@ -36,24 +36,24 @@ from typing import List
 
 import numpy as np
 
-from ..tensor import TensorModel, TensorProperty
+from ..lanes import (
+    ActorNetModel,
+    decode_register_clients,
+    register_client_deliver,
+    register_linearizable_lanes,
+)
+from ..tensor import TensorProperty
 
 # Message types (nonzero so an envelope word is never 0).
 PUT, GET, PUTOK, GETOK, PREPARE, PREPARED, ACCEPT, ACCEPTED, DECIDED = range(1, 10)
 
 _PAY_MASK = (1 << 20) - 1
 
-
-def _env(xp, typ, src, dst, pay):
-    """Envelope word: typ(4b)<<28 | src(4b)<<24 | dst(4b)<<20 | payload.
-
-    4-bit actor ids support 3 servers + up to 7 clients (the round-3
-    3-bit packing capped clients at 5, below the reference bench's
-    `paxos check 6` workload — bench.sh:31). The widest payload is
-    Prepared's 14 bits, comfortably inside 20.
-    """
-    u = xp.uint32
-    return (u(typ) << u(28)) | (src << u(24)) | (dst << u(20)) | pay
+# 4-bit actor ids support 3 servers + up to 7 clients (the round-3 3-bit
+# packing capped clients at 5, below the reference bench's `paxos check 6`
+# workload — bench.sh:31). The widest payload is Prepared's 14 bits,
+# comfortably inside the shared 20-bit field (lanes.env_word layout).
+from ..lanes import env_word as _env
 
 
 def _pop3(xp, bits):
@@ -61,7 +61,7 @@ def _pop3(xp, bits):
     return (bits & u(1)) + ((bits >> u(1)) & u(1)) + ((bits >> u(2)) & u(1))
 
 
-class PaxosTensor(TensorModel):
+class PaxosTensor(ActorNetModel):
     """Device twin of paxos_model(client_count, 3). See module docstring."""
 
     def __init__(self, client_count: int, server_count: int = 3):
@@ -78,97 +78,32 @@ class PaxosTensor(TensorModel):
         # messages per term, and terms <= client count (each Put is consumed
         # at most once and only proposal-less servers start terms).
         self.K = 14 * client_count
-        self.state_width = 6 + client_count + self.K
-        self.max_actions = self.K
-        self._net_base = 6 + client_count
+        self.n_actor_lanes = 6 + client_count
+        self._net_base = self.n_actor_lanes
 
     # -- init ---------------------------------------------------------------
 
     def init_states_array(self) -> np.ndarray:
-        row = np.zeros(self.state_width, dtype=np.uint32)
         # on_start: client 3+i sends Put to server (3+i) % 3; the tester's
         # write invocations all carry empty completed-maps (nothing has
         # completed yet), so they need no lanes.
-        puts = sorted(
-            (PUT << 28) | ((3 + i) << 24) | ((i % 3) << 20)
-            for i in range(self.c)
+        return self.pack_init_row(
+            [],
+            [
+                (PUT << 28) | ((3 + i) << 24) | ((i % 3) << 20)
+                for i in range(self.c)
+            ],
         )
-        for k, env in enumerate(puts):
-            row[self._net_base + self.K - len(puts) + k] = env
-        return row[None, :]
 
     # -- the batched deliver step -------------------------------------------
+    #
+    # step_lanes is inherited from ActorNetModel: one [K*B]-wide delivery
+    # handler + batched sorted-multiset network update (the O(K) XLA
+    # program that makes paxos-3 compilable).
 
-    def step_lanes(self, xp, lanes):
-        u = xp.uint32
-        K = self.K
-        NB = self._net_base
-        NA = 6 + self.c  # actor lanes (servers + clients)
-        net = list(lanes[NB : NB + K])
-        B = lanes[0].shape[0]
-
-        # Evaluate the delivery handler ONCE at [K*B] width — slot k's
-        # envelope against a broadcast copy of the actor lanes — instead of
-        # K unrolled handler instances. Same arithmetic, ~K x smaller XLA
-        # program (compile time), identical runtime traffic.
-        env_all = xp.concatenate(net)
-        big = [xp.concatenate([lanes[t]] * K) for t in range(NA)]
-        new_actor, m1, m2, m3, changed = self._deliver(xp, big, env_all)
-
-        # Batched network update, also at [K*B] width (one removal + three
-        # sorted-insert instances total, instead of K unrolled copies —
-        # this is what makes the XLA program O(K) and paxos-3 compilable;
-        # the per-slot form was the round-3 scale blocker).
-        #
-        # slot_id[j] = which net slot the j-th batch segment delivers.
-        slot_id = xp.concatenate(
-            [xp.full(B, k, dtype=xp.uint32) for k in range(K)]
-        )
-        # Remove the delivered slot from the ascending ring (zeros first):
-        # entries below it shift up one, slot 0 becomes empty.
-        bignet = [xp.concatenate([net[m]] * K) for m in range(K)]
-        cur = [
-            xp.where(
-                slot_id >= u(m),
-                bignet[m - 1] if m > 0 else u(0) * env_all,
-                bignet[m],
-            )
-            for m in range(K)
-        ]
-        for v in (m1, m2, m3):
-            # Insert v (when nonzero) into the ascending ring: entries
-            # below the insertion point shift up one (consuming a zero),
-            # the rest stay. All elementwise: the insertion rank is a
-            # lane-wise popcount, not a reduction.
-            has = v != u(0)
-            rank = u(0) * v
-            for m in range(1, K):
-                rank = rank + (cur[m] < v).astype(xp.uint32)
-            nxt = []
-            for m in range(K):
-                shifted = cur[m + 1] if m + 1 < K else v
-                placed = xp.where(
-                    u(m) < rank,
-                    shifted,
-                    xp.where(u(m) == rank, v, cur[m]),
-                )
-                nxt.append(xp.where(has, placed, cur[m]))
-            cur = nxt
-
-        occ_all = env_all != u(0)
-        mask_all = occ_all & (changed | (m1 != u(0)))
-        succs = []
-        masks = []
-        for k in range(K):
-            seg = slice(k * B, (k + 1) * B)
-            new_lanes = list(lanes)
-            for t in range(NA):
-                new_lanes[t] = new_actor[t][seg]
-            for m in range(K):
-                new_lanes[NB + m] = cur[m][seg]
-            succs.append(tuple(new_lanes))
-            masks.append(mask_all[seg])
-        return succs, masks
+    def deliver(self, xp, actor_lanes, env):
+        new_lanes, m1, m2, m3, changed = self._deliver(xp, actor_lanes, env)
+        return new_lanes, [m1, m2, m3], changed
 
     def _deliver(self, xp, lanes, env):
         """One batched delivery: `lanes` are the NA actor lanes (any width),
@@ -382,45 +317,28 @@ class PaxosTensor(TensorModel):
             s3 = xp.where(b_acd & quorum_a, acd_sends[2], s3)
             sends.append((s1, s2, s3))
 
-        # --- client handlers -------------------------------------
+        # --- client handlers (toolkit RegisterClient lane program) ----
+        client_lanes = [lanes[6 + j] for j in range(c)]
         for i in range(c):
             cid = 3 + i
             cond = occ & (dst == u(cid))
-            cl = lanes[6 + i]
-            phase = cl & u(3)
-
-            # PutOk completes the write; the read is invoked in the same
-            # step (the Get send), snapshotting every peer's completed-op
-            # count — which equals its phase (register.rs:131-146,
-            # linearizability.rs:77-84).
-            b_pok = cond & (typ == u(PUTOK)) & (phase == u(0))
-            ncl = (cl & ~u(3)) | u(1)
-            for pi in range(c):
-                if pi == i:
-                    continue
-                peer_phase = lanes[6 + pi] & u(3)
-                ncl = (ncl & ~(u(3) << u(6 + 2 * pi))) | (
-                    peer_phase << u(6 + 2 * pi)
-                )
             get_send = _env(
-                xp, GET, u(cid) + (src & u(0)), u((cid + 1) % 3) + (src & u(0)),
-                u(0) * cl,
+                xp, GET, u(cid) + (src & u(0)),
+                u((cid + 1) % 3) + (src & u(0)), u(0) * env,
             )
-
-            # GetOk completes the read; remember the returned value
-            # (part of the tester's identity).
-            b_gok = cond & (typ == u(GETOK)) & (phase == u(1))
-            gok_cl = (cl & ~u(0x3F)) | u(2) | ((pay & u(15)) << u(2))
-
-            ncl_out = cl
-            ncl_out = xp.where(b_pok, ncl, ncl_out)
-            ncl_out = xp.where(b_gok, gok_cl, ncl_out)
-            new_lanes[6 + i] = ncl_out
-            changed = changed | b_pok | b_gok
-
-            zero = u(0) * cl
-            s1 = xp.where(b_pok, get_send, zero)
-            sends.append((s1, zero, zero))
+            ncl, send, chg = register_client_deliver(
+                xp,
+                client_lanes,
+                i,
+                cond & (typ == u(PUTOK)),
+                cond & (typ == u(GETOK)),
+                pay,
+                get_send,
+            )
+            new_lanes[6 + i] = ncl
+            changed = changed | chg
+            zero = u(0) * env
+            sends.append((send, zero, zero))
 
         # Exactly one handler fires per delivery (dst is unique), so the
         # per-handler send words OR together.
@@ -436,87 +354,12 @@ class PaxosTensor(TensorModel):
     # -- properties ---------------------------------------------------------
 
     def linearizable_lanes(self, xp, lanes):
-        """Batched register-linearizability verdict from the client lanes.
-
-        The general tester backtracks (linearizability.rs:120-181), but THIS
-        workload admits an exact closed form: every client invokes its
-        (unique-valued) write at time zero and reads only after its own
-        write completes, so a linearization exists iff an ordering σ of the
-        c writes satisfies, for every COMPLETED read_j returning value k_j:
-
-          - gap placement: read_j sits immediately after write_{k_j} in σ
-            (reads impose no other register constraint),
-          - its own write precedes it:            j     <σ k_j,
-          - every write completed before read_j
-            was invoked (counter c_ij >= 1):      i     <σ k_j,
-          - every read completed before read_j
-            was invoked (counter c_ij == 2):      k_i   <σ k_j
-            (strict between distinct writes; same-gap reads order freely).
-
-        All constraints are binary precedences over c nodes, so existence
-        is ACYCLICITY of the induced digraph — evaluated here as pure
-        elementwise lane arithmetic (adjacency bitmask rows + log-depth
-        transitive closure), the shape the device engine needs. A completed
-        read returning None is impossible in any linearization (the
-        client's own write precedes it) and fails directly.
-
-        Validated state-for-state against a brute-force over all c!
-        serializations (tests/test_paxos_linearizable.py) and against the
-        host engines on the reachable space.
-        """
-        u = xp.uint32
-        c = self.c
-        cl = [lanes[6 + i] for i in range(c)]
-        phase = [cl[i] & u(3) for i in range(c)]
-        val = [(cl[i] >> u(2)) & u(15) for i in range(c)]
-        done = [phase[i] == u(2) for i in range(c)]
-        kk = [(val[i] - u(2)) & u(15) for i in range(c)]  # writer index read
-
-        false_ = lanes[0] != lanes[0]
-        none_read = false_
-        zero = u(0) * lanes[0]
-        adj = [zero for _ in range(c)]  # bit t of adj[r]: edge r -> t
-
-        def set_edge(row_static, tgt, cond):
-            # adj[row] |= (1 << tgt) where cond and tgt != row (data shift).
-            e = xp.where(
-                cond & (tgt != u(row_static)), u(1) << tgt, zero
-            )
-            adj[row_static] = adj[row_static] | e
-
-        for j in range(c):
-            rj = done[j]
-            none_read = none_read | (rj & (val[j] == u(1)))
-            set_edge(j, kk[j], rj)  # own write precedes own read
-            for i in range(c):
-                if i == j:
-                    continue
-                cij = (cl[j] >> u(6 + 2 * i)) & u(3)
-                # write_i completed before read_j invoked
-                set_edge(i, kk[j], rj & (cij >= u(1)))
-                # read_i completed before read_j invoked: k_i -> k_j
-                rr = rj & (cij == u(2))
-                for r in range(c):
-                    set_edge(r, kk[j], rr & (kk[i] == u(r)))
-
-        # Transitive closure by repeated relaxation (c <= 7 => 3 rounds of
-        # row-OR reach fixpoint: path lengths double each round).
-        rounds = max(1, (c - 1).bit_length())
-        for _ in range(rounds):
-            nxt = list(adj)
-            for i in range(c):
-                acc = nxt[i]
-                for k in range(c):
-                    acc = acc | xp.where(
-                        ((adj[i] >> u(k)) & u(1)) == u(1), adj[k], zero
-                    )
-                nxt[i] = acc
-            adj = nxt
-
-        cyclic = false_
-        for i in range(c):
-            cyclic = cyclic | (((adj[i] >> u(i)) & u(1)) == u(1))
-        return ~(cyclic | none_read)
+        """Register-linearizability verdict — the shared closed-form lane
+        program (see lanes.register_linearizable_lanes for the reduction
+        and its oracle validation)."""
+        return register_linearizable_lanes(
+            xp, [lanes[6 + i] for i in range(self.c)]
+        )
 
     def tensor_properties(self) -> List[TensorProperty]:
         NB = self._net_base
@@ -538,9 +381,6 @@ class PaxosTensor(TensorModel):
         ]
 
     # -- display ------------------------------------------------------------
-
-    def format_action(self, k: int) -> str:
-        return f"Deliver[net slot {k}]"
 
     def decode_state(self, row) -> dict:
         names = dict(
@@ -572,13 +412,7 @@ class PaxosTensor(TensorModel):
                     "decided": bool((a >> 20) & 1),
                 }
             )
-        clients = [
-            {
-                "phase": int(row[6 + i]) & 3,
-                "read_value": (int(row[6 + i]) >> 2) & 15,
-            }
-            for i in range(self.c)
-        ]
+        clients = decode_register_clients(row, 6, self.c)
         return {"servers": servers, "clients": clients, "net": net}
 
 
