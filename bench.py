@@ -95,12 +95,39 @@ def main() -> None:
     }
 
     # --- 2pc-7 headline throughput ----------------------------------------
+    # The golden is now a LIVE oracle: the vectorized threaded host engine
+    # re-derives it in under a second (native claim set + numpy lane
+    # batches, .threads(8)), so vs_baseline is honest, not a cached
+    # constant. If the native toolchain is unavailable, fall back to the
+    # cached constant so the headline still prints.
+    tpc7_golden = TPC7_GOLDEN
+    try:
+        # Warm the native build + tiny spawn OUTSIDE the timing window.
+        TensorModelAdapter(TwoPhaseTensor(3)).checker().threads(2).spawn_bfs().join()
+        t0 = time.perf_counter()
+        live7 = (
+            TensorModelAdapter(TwoPhaseTensor(7))
+            .checker()
+            .threads(8)
+            .spawn_bfs()
+            .join()
+        )
+        vb_secs = time.perf_counter() - t0
+        assert live7.unique_state_count() == TPC7_GOLDEN, (
+            live7.unique_state_count()
+        )
+        tpc7_golden = live7.unique_state_count()
+        detail["host_threaded_rate"] = round(live7.state_count() / vb_secs, 1)
+        detail["tpc7_oracle"] = "live"
+    except RuntimeError as e:
+        detail["tpc7_oracle"] = f"cached ({e})"
+
     tm7 = TwoPhaseTensor(7)
     opts = dict(chunk_size=6144, queue_capacity=1 << 20, table_capacity=1 << 22)
     TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts).join()  # compile
     med7, spread7, dev7 = timed3(
         lambda: TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts),
-        golden=TPC7_GOLDEN,
+        golden=tpc7_golden,
     )
     dev_rate = dev7.state_count() / med7
     detail["tpc7"] = {
@@ -123,6 +150,23 @@ def main() -> None:
     print(json.dumps(headline), flush=True)
 
     # --- paxos-2: the reference's flagship workload on device -------------
+    # Live oracle here too: the threaded host engine re-derives the
+    # reference golden (16,668) in ~0.5s (cached constant if the native
+    # toolchain is unavailable).
+    try:
+        livep = (
+            TensorModelAdapter(PaxosTensorExhaustive(2))
+            .checker()
+            .threads(8)
+            .spawn_bfs()
+            .join()
+        )
+        assert livep.unique_state_count() == PAXOS2_GOLDEN, (
+            livep.unique_state_count()
+        )
+    except RuntimeError:
+        pass
+
     px = PaxosTensorExhaustive(2)
     pxopts = dict(chunk_size=2048, queue_capacity=1 << 18, table_capacity=1 << 20)
     TensorModelAdapter(px).checker().spawn_tpu_bfs(**pxopts).join()  # compile

@@ -76,11 +76,14 @@ class CheckerBuilder:
     def threads(self, thread_count: int) -> "CheckerBuilder":
         """Worker count for engines that support parallel checking.
 
-        The host Python engines are single-threaded by design (state-space
-        parallelism is the device engine's job — `spawn_tpu_bfs`); they
-        raise NotImplementedError for thread_count > 1 rather than silently
-        ignoring it. The device engine accepts any value (its parallelism
-        is the data-parallel chunk, not worker threads).
+        With thread_count > 1, `spawn_bfs()` on a tensor-backed model runs
+        the vectorized threaded host engine (engines/vbfs.py: numpy lane
+        batches + the native concurrent visited set, reference
+        job_market.rs role); rich host models raise there. The other host
+        Python engines stay single-threaded and raise NotImplementedError
+        rather than silently ignoring the setting. The device engine
+        accepts any value (its parallelism is the data-parallel chunk, not
+        worker threads).
         """
         self.thread_count_ = thread_count
         return self
@@ -96,9 +99,23 @@ class CheckerBuilder:
     # -- engines ------------------------------------------------------------
 
     def spawn_bfs(self) -> "Checker":
+        # .threads(n > 1) routes tensor-backed models to the vectorized
+        # threaded engine (reference parity: multithreaded spawn_bfs,
+        # bfs.rs:90-164); rich host models raise TypeError there — state-
+        # space parallelism requires the lane encoding.
+        if self.thread_count_ > 1:
+            from .engines.vbfs import VectorizedBfsChecker
+
+            return VectorizedBfsChecker(self)
         from .engines.bfs import BfsChecker
 
         return BfsChecker(self)
+
+    def spawn_vbfs(self, **kw) -> "Checker":
+        """The vectorized threaded host engine over a TensorModel."""
+        from .engines.vbfs import VectorizedBfsChecker
+
+        return VectorizedBfsChecker(self, **kw)
 
     def spawn_dfs(self) -> "Checker":
         from .engines.dfs import DfsChecker

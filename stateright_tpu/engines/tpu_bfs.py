@@ -465,7 +465,6 @@ class TpuBfsChecker(HostEngineBase):
         model = builder.model
         if isinstance(model, TensorModel):
             model = TensorModelAdapter(model)
-            builder.model = model
         if not isinstance(model, TensorModelAdapter):
             raise TypeError(
                 "spawn_tpu_bfs requires a TensorModel (or its adapter); "

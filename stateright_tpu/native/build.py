@@ -1,7 +1,7 @@
-"""Build the native event-loop core: `python -m stateright_tpu.native.build`.
+"""Build the native components: `python -m stateright_tpu.native.build`.
 
-Compiles core.cpp into _core.so next to this file with g++ (no pybind11 —
-the binding layer is ctypes in runtime.py).
+Compiles each .cpp target into a .so next to this file with g++ (no
+pybind11 — the binding layers are ctypes in runtime.py / vset.py).
 """
 
 from __future__ import annotations
@@ -12,12 +12,21 @@ import subprocess
 import sys
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-SOURCE = os.path.join(_DIR, "core.cpp")
-OUTPUT = os.path.join(_DIR, "_core.so")
+
+# (source, output) pairs; each is an independent shared object.
+TARGETS = {
+    "core": (os.path.join(_DIR, "core.cpp"), os.path.join(_DIR, "_core.so")),
+    "checker": (
+        os.path.join(_DIR, "checker.cpp"),
+        os.path.join(_DIR, "_checker.so"),
+    ),
+}
+
+# Backwards-compatible aliases (round 1-3 callers import these).
+SOURCE, OUTPUT = TARGETS["core"]
 
 
-def build(quiet: bool = False) -> bool:
-    """Compile the core; returns True on success."""
+def build_one(source: str, output: str, quiet: bool = False) -> bool:
     gxx = shutil.which("g++") or shutil.which("c++")
     if gxx is None:
         if not quiet:
@@ -30,8 +39,8 @@ def build(quiet: bool = False) -> bool:
         "-shared",
         "-fPIC",
         "-o",
-        OUTPUT,
-        SOURCE,
+        output,
+        source,
         "-lpthread",
     ]
     try:
@@ -47,13 +56,28 @@ def build(quiet: bool = False) -> bool:
     return True
 
 
-def is_built() -> bool:
-    return os.path.exists(OUTPUT) and os.path.getmtime(OUTPUT) >= os.path.getmtime(
-        SOURCE
+def build(quiet: bool = False, target: str = "core") -> bool:
+    """Compile one target; returns True on success."""
+    source, output = TARGETS[target]
+    return build_one(source, output, quiet)
+
+
+def build_all(quiet: bool = False) -> bool:
+    ok = True
+    for name in TARGETS:
+        ok = build(quiet, name) and ok
+    return ok
+
+
+def is_built(target: str = "core") -> bool:
+    source, output = TARGETS[target]
+    return os.path.exists(output) and os.path.getmtime(output) >= os.path.getmtime(
+        source
     )
 
 
 if __name__ == "__main__":
-    ok = build()
-    print(f"native core: {'built ' + OUTPUT if ok else 'BUILD FAILED'}")
+    ok = build_all()
+    for name, (_src, out) in TARGETS.items():
+        print(f"native {name}: {'built ' + out if ok else 'BUILD FAILED'}")
     sys.exit(0 if ok else 1)

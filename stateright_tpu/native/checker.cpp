@@ -1,0 +1,158 @@
+// Native threaded visited-key set for the vectorized host BFS engine.
+//
+// Role parity: the reference's concurrent visited map + work-stealing
+// checker threads (src/checker/bfs.rs:29-30, src/job_market.rs:59-182).
+// The host engine evaluates model steps as vectorized numpy batches (the
+// same lane programs the device runs), so the parallel work here is the
+// part numpy cannot do: claim-arbitrated membership over a shared hash
+// set. Threads partition each candidate batch and insert via compare-
+// exchange — the exact protocol the TPU engine's claim rounds implement
+// with scatter/readback, expressed with hardware atomics.
+//
+// Keys are nonzero uint64 fingerprints (0 = empty slot). Double hashing:
+// slot0 = key & mask, stride = (key >> 32) | 1 (odd, so it cycles the
+// power-of-two table). The caller keeps the load factor <= 0.5 by growing
+// (create a larger set, bulk re-insert) — at that load, probe chains are
+// short and a fixed budget suffices; exhaustion is reported, never
+// silently dropped.
+//
+// C ABI only (loaded via ctypes; no pybind11 in this environment).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxProbes = 128;
+
+struct KeySet {
+  std::vector<std::atomic<uint64_t>> slots;
+  std::atomic<uint64_t> count{0};
+  uint64_t mask;
+  explicit KeySet(uint64_t cap) : slots(cap), mask(cap - 1) {
+    for (auto& s : slots) s.store(0, std::memory_order_relaxed);
+  }
+};
+
+std::mutex g_mu;
+std::map<int64_t, KeySet*> g_sets;
+int64_t g_next = 1;
+
+KeySet* lookup(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_sets.find(h);
+  return it == g_sets.end() ? nullptr : it->second;
+}
+
+// Insert keys[lo, hi) and set out_new[i] = 1 for each claimed key.
+// Returns the number of keys whose probe budget was exhausted.
+int64_t insert_range(KeySet* ks, const uint64_t* keys, int64_t lo, int64_t hi,
+                     uint8_t* out_new) {
+  int64_t unresolved = 0;
+  uint64_t claimed = 0;
+  for (int64_t i = lo; i < hi; i++) {
+    uint64_t key = keys[i];
+    out_new[i] = 0;
+    if (key == 0) continue;  // reserved sentinel; caller remaps
+    uint64_t idx = key & ks->mask;
+    uint64_t stride = (key >> 32) | 1;
+    bool done = false;
+    for (int p = 0; p < kMaxProbes; p++) {
+      uint64_t cur = ks->slots[idx].load(std::memory_order_relaxed);
+      if (cur == key) {
+        done = true;  // already visited (or in-batch duplicate lost)
+        break;
+      }
+      if (cur == 0) {
+        uint64_t expected = 0;
+        if (ks->slots[idx].compare_exchange_strong(
+                expected, key, std::memory_order_relaxed)) {
+          out_new[i] = 1;
+          claimed++;
+          done = true;
+          break;
+        }
+        if (expected == key) {  // another thread claimed this very key
+          done = true;
+          break;
+        }
+        // Foreign key won the slot; fall through to advance.
+      }
+      idx = (idx + stride) & ks->mask;
+    }
+    if (!done) unresolved++;
+  }
+  ks->count.fetch_add(claimed, std::memory_order_relaxed);
+  return unresolved;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t vset_create(uint64_t capacity) {
+  if (capacity == 0 || (capacity & (capacity - 1))) return -1;
+  auto* ks = new KeySet(capacity);
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next++;
+  g_sets[h] = ks;
+  return h;
+}
+
+void vset_destroy(int64_t h) {
+  KeySet* ks = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_sets.find(h);
+    if (it == g_sets.end()) return;
+    ks = it->second;
+    g_sets.erase(it);
+  }
+  delete ks;
+}
+
+uint64_t vset_len(int64_t h) {
+  KeySet* ks = lookup(h);
+  return ks ? ks->count.load(std::memory_order_relaxed) : 0;
+}
+
+uint64_t vset_capacity(int64_t h) {
+  KeySet* ks = lookup(h);
+  return ks ? ks->mask + 1 : 0;
+}
+
+// Threaded batch insert. out_new[i] = 1 iff keys[i] claimed a fresh slot
+// (exactly one winner among in-batch duplicates). Returns the number of
+// unresolved keys (probe budget exhausted; caller must grow and retry) or
+// -1 for a bad handle.
+int64_t vset_insert_batch(int64_t h, const uint64_t* keys, int64_t n,
+                          uint8_t* out_new, int32_t nthreads) {
+  KeySet* ks = lookup(h);
+  if (!ks) return -1;
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads == 1 || n < 4096) {
+    return insert_range(ks, keys, 0, n, out_new);
+  }
+  std::vector<std::thread> threads;
+  std::vector<int64_t> unresolved(nthreads, 0);
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; t++) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back([=, &unresolved] {
+      unresolved[t] = insert_range(ks, keys, lo, hi, out_new);
+    });
+  }
+  for (auto& th : threads) th.join();
+  int64_t total = 0;
+  for (auto u : unresolved) total += u;
+  return total;
+}
+
+}  // extern "C"

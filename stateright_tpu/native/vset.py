@@ -1,0 +1,132 @@
+"""ctypes binding over the native threaded visited-key set (_checker.so).
+
+See checker.cpp for the protocol. The binding auto-builds the shared
+object on first use (mirroring runtime.py) and exposes a growable wrapper:
+the C side owns a fixed-capacity atomic table; `VisitedSet` grows it by
+creating a larger one and bulk re-inserting the retained keys.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_checker.so")
+
+_lib = None
+_lib_mu = threading.Lock()
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lib_mu:
+        if _lib is not None:
+            return _lib
+        from . import build
+
+        if not build.is_built("checker"):
+            if not build.build(quiet=True, target="checker"):
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.vset_create.restype = ctypes.c_int64
+        lib.vset_create.argtypes = [ctypes.c_uint64]
+        lib.vset_destroy.restype = None
+        lib.vset_destroy.argtypes = [ctypes.c_int64]
+        lib.vset_len.restype = ctypes.c_uint64
+        lib.vset_len.argtypes = [ctypes.c_int64]
+        lib.vset_capacity.restype = ctypes.c_uint64
+        lib.vset_capacity.argtypes = [ctypes.c_int64]
+        lib.vset_insert_batch.restype = ctypes.c_int64
+        lib.vset_insert_batch.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib
+
+
+class VisitedSet:
+    """Growable threaded visited set over nonzero uint64 fingerprints."""
+
+    MAX_LOAD = 0.5
+
+    def __init__(self, capacity: int = 1 << 16):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(
+                "native checker extension unavailable "
+                "(run: python -m stateright_tpu.native.build)"
+            )
+        cap = 1 << max(10, (capacity - 1).bit_length())
+        self._h = self._lib.vset_create(cap)
+        self._cap = cap
+        # Dense copy of inserted keys, for growth re-insertion (and cheap
+        # iteration); parents are tracked by the engine.
+        self._keys: list = []
+
+    def __len__(self) -> int:
+        return int(self._lib.vset_len(self._h))
+
+    def insert_batch(self, keys: np.ndarray, nthreads: int) -> np.ndarray:
+        """Insert nonzero uint64 keys; returns the is_new bool mask."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        n = len(keys)
+        while len(self) + n > self.MAX_LOAD * self._cap:
+            self._grow(nthreads)
+        out = np.zeros(n, dtype=np.uint8)
+        rc = self._lib.vset_insert_batch(
+            self._h,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            nthreads,
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"native visited set: {rc} unresolved inserts despite "
+                "load-factor headroom"
+            )
+        mask = out.astype(bool)
+        if mask.any():
+            self._keys.append(keys[mask])
+        return mask
+
+    def _grow(self, nthreads: int) -> None:
+        new_cap = self._cap * 2
+        new_h = self._lib.vset_create(new_cap)
+        try:
+            if self._keys:
+                all_keys = np.concatenate(self._keys)
+                out = np.zeros(len(all_keys), dtype=np.uint8)
+                rc = self._lib.vset_insert_batch(
+                    new_h,
+                    all_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                    len(all_keys),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    nthreads,
+                )
+                if rc != 0:
+                    raise RuntimeError("native visited set: rehash failed")
+                self._keys = [all_keys]
+        except Exception:
+            self._lib.vset_destroy(new_h)  # don't leak the half-built table
+            raise
+        self._lib.vset_destroy(self._h)
+        self._h = new_h
+        self._cap = new_cap
+
+    def __del__(self):
+        try:
+            if self._lib is not None:
+                self._lib.vset_destroy(self._h)
+        except Exception:
+            pass

@@ -341,7 +341,6 @@ class ShardedBfsChecker(HostEngineBase):
         model = builder.model
         if isinstance(model, TensorModel):
             model = TensorModelAdapter(model)
-            builder.model = model
         if not isinstance(model, TensorModelAdapter):
             raise TypeError(
                 "spawn_sharded_bfs requires a TensorModel (or its adapter)"

@@ -1,0 +1,110 @@
+"""Vectorized threaded host engine: parity with the reference engine.
+
+`.threads(n).spawn_bfs()` on a tensor-backed checker routes to the
+vectorized engine (native claim set + numpy lane batches); these tests pin
+its semantics to the single-threaded reference engine on every observable:
+unique counts, generated counts, discoveries, shortest paths, eventually
+properties, targets, and depth limits.
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
+from stateright_tpu.models.abd import AbdTensor
+from stateright_tpu.tensor import TensorModel, TensorModelAdapter, TensorProperty
+
+
+def both(tm_factory, configure=lambda c: c, threads=4):
+    plain = configure(TensorModelAdapter(tm_factory()).checker()).spawn_bfs().join()
+    vec = (
+        configure(TensorModelAdapter(tm_factory()).checker())
+        .threads(threads)
+        .spawn_bfs()
+        .join()
+    )
+    return plain, vec
+
+
+def test_counts_and_discoveries_2pc5():
+    plain, vec = both(lambda: TwoPhaseTensor(5))
+    assert vec.unique_state_count() == plain.unique_state_count() == 8832
+    assert vec.state_count() == plain.state_count()
+    assert vec.max_depth() == plain.max_depth()
+    assert (vec.discovery("consistent") is None) == (
+        plain.discovery("consistent") is None
+    )
+
+
+def test_shortest_counterexample_increment_race():
+    plain, vec = both(lambda: IncrementTensor(2))
+    tp, tv = plain.discovery("fin"), vec.discovery("fin")
+    assert tv is not None
+    assert len(tv.into_actions()) == len(tp.into_actions()) == 4
+    # the trace replays through the model
+    assert tv.into_actions()
+
+
+def test_abd_golden():
+    plain, vec = both(lambda: AbdTensor(2))
+    assert vec.unique_state_count() == plain.unique_state_count() == 544
+    assert vec.discovery("linearizable") is None
+
+
+def test_eventually_terminal_discoveries():
+    class Counter(TensorModel):
+        """Counts 0..3; 'reaches 5' eventually-property must be discovered
+        at the terminal state (3) with the bit still pending."""
+
+        state_width = 1
+        max_actions = 1
+
+        def init_states_array(self):
+            return np.zeros((1, 1), dtype=np.uint32)
+
+        def step_lanes(self, xp, lanes):
+            u = xp.uint32
+            return [(lanes[0] + u(1),)], [lanes[0] < u(3)]
+
+        def tensor_properties(self):
+            return [
+                TensorProperty.eventually(
+                    "reaches 5", lambda xp, l: l[0] == xp.uint32(5)
+                )
+            ]
+
+    plain, vec = both(Counter)
+    assert vec.unique_state_count() == plain.unique_state_count() == 4
+    tp, tv = plain.discovery("reaches 5"), vec.discovery("reaches 5")
+    assert tv is not None and tp is not None
+    assert len(tv.into_actions()) == len(tp.into_actions()) == 3
+
+
+def test_target_state_count_and_depth():
+    _plain, vec = both(
+        lambda: TwoPhaseTensor(5), lambda c: c.target_state_count(2000)
+    )
+    assert vec.state_count() >= 2000
+    _plain, vec2 = both(
+        lambda: TwoPhaseTensor(5), lambda c: c.target_max_depth(3)
+    )
+    assert vec2.max_depth() <= 3
+
+
+def test_visited_set_growth():
+    from stateright_tpu.native.vset import VisitedSet
+
+    vs = VisitedSet(1 << 10)
+    rng = np.random.default_rng(7)
+    keys = rng.integers(1, 2**63, size=5000, dtype=np.uint64)
+    new1 = vs.insert_batch(keys, 4)  # forces several growths
+    assert len(vs) == len(np.unique(keys)) == new1.sum()
+    new2 = vs.insert_batch(keys, 4)
+    assert not new2.any()
+
+
+def test_rich_host_models_rejected():
+    from stateright_tpu.models.fixtures import BinaryClock
+
+    with pytest.raises((TypeError, NotImplementedError)):
+        BinaryClock().checker().threads(4).spawn_bfs()
