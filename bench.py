@@ -136,6 +136,7 @@ def main() -> None:
         "secs_median": round(med7, 3),
         "secs_spread": [round(s, 3) for s in spread7],
         "golden_match": True,
+        "telemetry": dev7.telemetry(),
     }
     # Preliminary line: if a harness timeout cuts the remaining sections,
     # the last complete line still carries the headline metric.
@@ -164,8 +165,9 @@ def main() -> None:
         assert livep.unique_state_count() == PAXOS2_GOLDEN, (
             livep.unique_state_count()
         )
-    except RuntimeError:
-        pass
+        detail["paxos2_oracle"] = "live"
+    except RuntimeError as e:
+        detail["paxos2_oracle"] = f"cached ({e})"
 
     px = PaxosTensorExhaustive(2)
     pxopts = dict(chunk_size=2048, queue_capacity=1 << 18, table_capacity=1 << 20)

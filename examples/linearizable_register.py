@@ -226,6 +226,36 @@ def abd_model(
     )
 
 
+def spawn_info():
+    """Run a real 2-server ABD cluster over UDP
+    (linearizable-register.rs:257-284)."""
+    from stateright_tpu.actor import Id
+    from stateright_tpu.actor.spawn import (
+        json_serializer,
+        make_json_deserializer,
+        spawn,
+    )
+
+    port = 3000
+    ids = [Id.from_addr("127.0.0.1", port + i) for i in range(2)]
+    print("  A set of servers that implement a linearizable register.")
+    print("  You can monitor and interact using tcpdump and netcat:")
+    print(f"$ nc -u localhost {port}")
+    print('["Put", 1, "X"]')
+    print('["Get", 2]')
+    spawn(
+        json_serializer,
+        make_json_deserializer(
+            Put, PutOk, Get, GetOk, Internal, Query, AckQuery, Record,
+            AckRecord,
+        ),
+        [
+            (ids[i], AbdActor([ids[j] for j in range(2) if j != i]))
+            for i in range(2)
+        ],
+    )
+
+
 def main(argv=None):
     from examples._cli import example_main
 
@@ -234,6 +264,7 @@ def main(argv=None):
         name="a linearizable register",
         build_model=lambda client_count, network: abd_model(client_count, 2, network),
         default_client_count=2,
+        spawn_info=spawn_info,
     )
 
 

@@ -234,6 +234,35 @@ def paxos_model(
     )
 
 
+def spawn_info():
+    """Run a real 3-server paxos cluster over UDP (paxos.rs:445-494)."""
+    from stateright_tpu.actor import Id
+    from stateright_tpu.actor.spawn import (
+        json_serializer,
+        make_json_deserializer,
+        spawn,
+    )
+
+    port = 3000
+    ids = [Id.from_addr("127.0.0.1", port + i) for i in range(3)]
+    print("  A set of servers that implement Single Decree Paxos.")
+    print("  You can monitor and interact using tcpdump and netcat:")
+    print(f"$ nc -u localhost {port}")
+    print('["Put", 1, "X"]')
+    print('["Get", 2]')
+    spawn(
+        json_serializer,
+        make_json_deserializer(
+            Put, PutOk, Get, GetOk, Internal, Prepare, Prepared, Accept,
+            Accepted, Decided,
+        ),
+        [
+            (ids[i], PaxosActor([ids[j] for j in range(3) if j != i]))
+            for i in range(3)
+        ],
+    )
+
+
 def main(argv=None):
     from examples._cli import example_main
 
@@ -245,7 +274,7 @@ def main(argv=None):
         ),
         default_client_count=2,
         default_network="unordered_nonduplicating",
-        spawn_info=None,
+        spawn_info=spawn_info,
     )
 
 

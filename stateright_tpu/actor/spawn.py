@@ -80,7 +80,13 @@ def _from_jsonable(value, by_name):
         fields = [_from_jsonable(v, by_name) for v in value[1:]]
         return cls(*fields)
     if isinstance(value, list):
-        return [_from_jsonable(v, by_name) for v in value]
+        # TUPLES, not lists: dataclass fields like paxos ballots are
+        # (round, id) tuples that handlers compare (`msg.ballot >
+        # state.ballot`); a JSON round-trip to list would make those
+        # comparisons raise inside the actor loop (messages silently
+        # dropped). JSON has no list/tuple distinction, so tuple is the
+        # faithful decoding for message payloads.
+        return tuple(_from_jsonable(v, by_name) for v in value)
     return value
 
 

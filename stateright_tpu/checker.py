@@ -187,6 +187,13 @@ class Checker:
     def join(self) -> "Checker":
         return self
 
+    def telemetry(self) -> Dict[str, Any]:
+        """Engine-internal gauges (device engines: load factor, take_cap,
+        steps/era, spill volume). Empty for engines without telemetry; an
+        occupancy or throughput regression should be visible here without
+        STPU_DEBUG."""
+        return {}
+
     # -- on-demand engine hooks (no-ops elsewhere; checker.rs:298-306) ------
 
     def check_fingerprint(self, fingerprint: int) -> None:
@@ -243,6 +250,7 @@ class Checker:
                 max_depth=self.max_depth(),
                 duration_secs=time.monotonic() - start,
                 done=True,
+                telemetry=self.telemetry(),
             )
         )
         discoveries = {

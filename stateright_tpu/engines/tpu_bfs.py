@@ -504,6 +504,11 @@ class TpuBfsChecker(HostEngineBase):
         # Checkpoint/resume: a capability the reference lacks (its runs are
         # in-memory only, SURVEY.md §5) — the dense table/ring layout makes
         # a checkpoint a straight array download.
+        if checkpoint_every is not None and checkpoint_path is None:
+            raise ValueError(
+                "checkpoint_every requires checkpoint_path (nothing would "
+                "be written otherwise)"
+            )
         self._ckpt_path = checkpoint_path
         self._ckpt_every = checkpoint_every
         self._resume_from = resume_from
@@ -514,6 +519,17 @@ class TpuBfsChecker(HostEngineBase):
         self._unique = 0
         self._discovery_fps: Dict[str, int] = {}
         self._spill: List[np.ndarray] = []
+        # Telemetry gauges (surfaced via Checker.telemetry / report):
+        # eras dispatched, steps executed, spill/refill row volume, table
+        # growths, final take_cap — the engine's health at a glance.
+        self._telemetry: Dict[str, Any] = {
+            "eras": 0,
+            "steps": 0,
+            "spill_rows": 0,
+            "refill_rows": 0,
+            "table_growths": 0,
+            "take_cap": self._chunk,
+        }
 
         self._init_ebits_tensor = 0
         e = 0
@@ -668,6 +684,7 @@ class TpuBfsChecker(HostEngineBase):
                     for i in range(W)
                 )
                 count += k
+                self._telemetry["refill_rows"] += k
                 host_dirty = True
             if count == 0:
                 break
@@ -678,6 +695,7 @@ class TpuBfsChecker(HostEngineBase):
             rcap = _rcap(A, C)
             while self._unique + rcap > vs.MAX_LOAD * self._tcap:
                 table, self._tcap = self._grow_table(table)
+                self._telemetry["table_growths"] += 1
                 host_dirty = True
             grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - rcap)
 
@@ -741,6 +759,9 @@ class TpuBfsChecker(HostEngineBase):
             head = int(vals[0])
             count = int(vals[1])
             take_cap = int(vals[P_TAKE_CAP])
+            self._telemetry["eras"] += 1
+            self._telemetry["steps"] += int(vals[10])
+            self._telemetry["take_cap"] = take_cap
             self._unique = int(vals[2])
             self._state_count += int(vals[8])
             self._max_depth = max(self._max_depth, int(vals[9]))
@@ -775,6 +796,7 @@ class TpuBfsChecker(HostEngineBase):
                 for off in range(0, k, C * A):
                     self._spill.append(big[off : off + C * A])
                 count -= k
+                self._telemetry["spill_rows"] += k
                 # Refills can place these rows after deeper children, breaking
                 # the ring's depth monotonicity that the block-level maxd read
                 # relies on — fold their depth in here. (Counts rows that are
@@ -933,6 +955,13 @@ class TpuBfsChecker(HostEngineBase):
         )
 
     # -- accessors ----------------------------------------------------------
+
+    def telemetry(self) -> Dict[str, Any]:
+        t = dict(self._telemetry)
+        t["table_capacity"] = self._tcap
+        t["load_factor"] = round(self._unique / self._tcap, 4)
+        t["chunk"] = self._chunk
+        return t
 
     def unique_state_count(self) -> int:
         return self._unique

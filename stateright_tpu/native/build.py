@@ -62,11 +62,9 @@ def build(quiet: bool = False, target: str = "core") -> bool:
     return build_one(source, output, quiet)
 
 
-def build_all(quiet: bool = False) -> bool:
-    ok = True
-    for name in TARGETS:
-        ok = build(quiet, name) and ok
-    return ok
+def build_all(quiet: bool = False) -> dict:
+    """Build every target; returns {name: succeeded}."""
+    return {name: build(quiet, name) for name in TARGETS}
 
 
 def is_built(target: str = "core") -> bool:
@@ -77,7 +75,8 @@ def is_built(target: str = "core") -> bool:
 
 
 if __name__ == "__main__":
-    ok = build_all()
+    results = build_all()
     for name, (_src, out) in TARGETS.items():
-        print(f"native {name}: {'built ' + out if ok else 'BUILD FAILED'}")
-    sys.exit(0 if ok else 1)
+        status = "built " + out if results[name] else "BUILD FAILED"
+        print(f"native {name}: {status}")
+    sys.exit(0 if all(results.values()) else 1)

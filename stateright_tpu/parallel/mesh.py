@@ -334,6 +334,9 @@ class ShardedBfsChecker(HostEngineBase):
         queue_capacity_per_shard: int = 1 << 16,
         table_capacity_per_shard: int = 1 << 18,
         sync_steps: int = 64,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: Optional[float] = None,
+        resume_from: Optional[str] = None,
     ):
         import jax
         from jax.sharding import Mesh
@@ -382,6 +385,21 @@ class ShardedBfsChecker(HostEngineBase):
         self._unique = 0
         self._discovery_fps: Dict[str, int] = {}
         self._spill: List[List[np.ndarray]] = [[] for _ in range(self.n_shards)]
+        # Sharded checkpoint/resume: per-shard tables, rings, spill lists,
+        # take_caps and counters serialize to one .npz at block boundaries
+        # (all arrays are host-visible there). The reference has no
+        # equivalent — killed runs restart from scratch.
+        if checkpoint_every is not None and checkpoint_path is None:
+            raise ValueError(
+                "checkpoint_every requires checkpoint_path (nothing would "
+                "be written otherwise)"
+            )
+        self._ckpt_path = checkpoint_path
+        self._ckpt_every = checkpoint_every
+        self._resume_from = resume_from
+        import time as _time
+
+        self._last_ckpt = _time.monotonic()
         self._init_ebits = 0
         e = 0
         for p in self._tprops:
@@ -404,6 +422,30 @@ class ShardedBfsChecker(HostEngineBase):
         N = self.n_shards
         NP_ = len(self._tprops)
         W = S + 4
+
+        if self._resume_from is not None:
+            (
+                table,
+                queue,
+                heads,
+                counts,
+                rec_bits,
+                rec_fp1,
+                rec_fp2,
+                take_caps,
+                disc_depth_best,
+                per_shard_unique,
+            ) = self._load_checkpoint(self._resume_from, W)
+            depth_limit = (
+                self._target_max_depth
+                if self._target_max_depth is not None
+                else 0xFFFFFFFF
+            )
+            return self._run_loop(
+                table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+                take_caps, disc_depth_best, per_shard_unique, depth_limit,
+                self._qcap - N * self._quota, 4, W,
+            )
 
         inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
         init_lanes = tuple(inits[:, i] for i in range(S))
@@ -462,6 +504,28 @@ class ShardedBfsChecker(HostEngineBase):
         take_caps = [self._chunk] * N
         disc_depth_best: Dict[str, int] = {}
         per_shard_unique = self._per_shard_uniques(table_np)
+        return self._run_loop(
+            table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+            take_caps, disc_depth_best, per_shard_unique, depth_limit,
+            high_water, sync_steps, W,
+        )
+
+    def _run_loop(
+        self, table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+        take_caps, disc_depth_best, per_shard_unique, depth_limit,
+        high_water, sync_steps, W,
+    ) -> None:
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from ..ops import visited_set as vs
+
+        tm = self.tm
+        S = tm.state_width
+        A = tm.max_actions
+        C = self._chunk
+        N = self.n_shards
 
         while counts.sum() > 0 or any(self._spill[s] for s in range(N)):
             # Refill spills per shard.
@@ -567,6 +631,15 @@ class ShardedBfsChecker(HostEngineBase):
                         self._max_depth, int(block[:, S + 3].max())
                     )
 
+            if self._ckpt_path is not None and (
+                self._ckpt_every is not None
+                and _time.monotonic() - self._last_ckpt >= self._ckpt_every
+            ):
+                self._save_checkpoint(
+                    table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+                    take_caps, disc_depth_best, per_shard_unique,
+                )
+
             if self._finish_matched(self._discovery_fps):
                 break
             if (
@@ -577,8 +650,127 @@ class ShardedBfsChecker(HostEngineBase):
             if self._timed_out():
                 break
 
+        if self._ckpt_path is not None:
+            self._save_checkpoint(
+                table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+                take_caps, disc_depth_best, per_shard_unique,
+            )
         self._table_dev = table
         return
+
+    # -- checkpoint/resume --------------------------------------------------
+
+    def _save_checkpoint(
+        self, table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+        take_caps, disc_depth_best, per_shard_unique,
+    ) -> None:
+        """Serialize the full sharded engine state (per-shard tables, rings,
+        spill lists, take_caps, counters) to one .npz, written atomically.
+        Mirrors the single-device engine's checkpoint (engines/tpu_bfs.py);
+        the reference has no equivalent."""
+        import json
+        import os
+        import time as _time
+
+        meta = {
+            "n_shards": self.n_shards,
+            "qcap": self._qcap,
+            "tcap": self._tcap,
+            "chunk": self._chunk,
+            "quota": self._quota,
+            "state_width": self.tm.state_width,
+            "model": f"{type(self.tm).__module__}.{type(self.tm).__qualname__}",
+            "model_config": self.tm.config_digest(),
+            "prop_names": [p.name for p in self._tprops],
+            "rec_bits": rec_bits,
+            "state_count": self._state_count,
+            "unique": self._unique,
+            "max_depth": self._max_depth,
+            "discovery_fps": {k: str(v) for k, v in self._discovery_fps.items()},
+            "disc_depth_best": {k: int(v) for k, v in disc_depth_best.items()},
+            "per_shard_unique": [int(u) for u in per_shard_unique],
+            "take_caps": [int(t) for t in take_caps],
+        }
+        arrays = {
+            "meta": np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8
+            ).copy(),
+            "heads": np.asarray(heads, dtype=np.int64),
+            "counts": np.asarray(counts, dtype=np.int64),
+            "rec_fp1": np.asarray(rec_fp1),
+            "rec_fp2": np.asarray(rec_fp2),
+        }
+        for t in range(4):
+            arrays[f"table{t}"] = np.asarray(table[t])
+        for w, lane in enumerate(queue):
+            arrays[f"queue{w}"] = np.asarray(lane)
+        for s in range(self.n_shards):
+            for i, blk in enumerate(self._spill[s]):
+                arrays[f"spill_{s}_{i}"] = blk
+        tmp = self._ckpt_path + ".tmp.npz"
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, self._ckpt_path)
+        self._last_ckpt = _time.monotonic()
+
+    def _load_checkpoint(self, path: str, W: int):
+        import json
+
+        import jax.numpy as jnp
+
+        data = np.load(path)
+        meta = json.loads(bytes(data["meta"]).decode())
+        if (
+            meta["n_shards"] != self.n_shards
+            or meta["qcap"] != self._qcap
+            or meta["state_width"] != self.tm.state_width
+        ):
+            raise ValueError(
+                "checkpoint was written with a different shard count, queue "
+                "capacity, or model encoding; resume with matching options"
+            )
+        this_model = f"{type(self.tm).__module__}.{type(self.tm).__qualname__}"
+        if meta["model"] != this_model:
+            raise ValueError(
+                f"checkpoint was written by model {meta['model']!r}; resuming "
+                f"it with {this_model!r} would silently produce wrong results"
+            )
+        if meta["model_config"] != self.tm.config_digest():
+            raise ValueError(
+                "checkpoint model config does not match this instance"
+            )
+        this_props = [p.name for p in self._tprops]
+        if meta["prop_names"] != this_props:
+            raise ValueError(
+                f"checkpoint property set {meta['prop_names']} does not "
+                f"match this checker's {this_props}"
+            )
+        self._tcap = meta["tcap"]
+        self._state_count = meta["state_count"]
+        self._unique = meta["unique"]
+        self._max_depth = meta["max_depth"]
+        self._discovery_fps = {
+            k: int(v) for k, v in meta["discovery_fps"].items()
+        }
+        for s in range(self.n_shards):
+            blocks = sorted(
+                (k for k in data.files if k.startswith(f"spill_{s}_")),
+                key=lambda n: int(n.rsplit("_", 1)[1]),
+            )
+            self._spill[s] = [data[k] for k in blocks]
+        table = tuple(jnp.asarray(data[f"table{t}"]) for t in range(4))
+        queue = tuple(jnp.asarray(data[f"queue{w}"]) for w in range(W))
+        return (
+            table,
+            queue,
+            data["heads"].astype(np.int64),
+            data["counts"].astype(np.int64),
+            meta["rec_bits"],
+            jnp.asarray(data["rec_fp1"]),
+            jnp.asarray(data["rec_fp2"]),
+            list(meta["take_caps"]),
+            {k: int(v) for k, v in meta["disc_depth_best"].items()},
+            list(meta["per_shard_unique"]),
+        )
 
     @staticmethod
     def _host_insert(table_shard: np.ndarray, h1: int, h2: int) -> None:

@@ -13,13 +13,17 @@ from typing import Any, Dict, TextIO
 
 @dataclass
 class ReportData:
-    """Reference: report.rs:10-21."""
+    """Reference: report.rs:10-21 (+ engine telemetry, this framework)."""
 
     total_states: int
     unique_states: int
     max_depth: int
     duration_secs: float
     done: bool
+    # Engine-specific gauges (device engines: load factor, take_cap,
+    # steps/era, spill volume — reference report.rs has no equivalent;
+    # empty for engines without telemetry).
+    telemetry: Dict[str, Any] = None
 
 
 @dataclass
@@ -56,6 +60,11 @@ class WriteReporter(Reporter):
                 f"Done. states={data.total_states}, unique={data.unique_states}, "
                 f"depth={data.max_depth}, sec={int(data.duration_secs)}\n"
             )
+            if data.telemetry:
+                pairs = ", ".join(
+                    f"{k}={v}" for k, v in sorted(data.telemetry.items())
+                )
+                self.writer.write(f"Telemetry. {pairs}\n")
         else:
             self.writer.write(
                 f"Checking. states={data.total_states}, "

@@ -25,6 +25,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's wall-clock is dominated by XLA
+# compiles (every engine-option variation builds a fresh loop); caching them
+# across runs keeps CI honest as coverage grows. Safe to share: entries key
+# on the full HLO + compile options.
+_CACHE_DIR = os.environ.get(
+    "STPU_JAX_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 

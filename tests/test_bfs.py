@@ -77,7 +77,9 @@ def test_target_max_depth_limits_depth():
 def test_threads_gt1_raises_on_host_engines():
     from stateright_tpu.models.fixtures import BinaryClock
 
-    with pytest.raises(NotImplementedError, match="single-threaded"):
+    # threads>1 spawn_bfs routes to the vectorized engine, which requires
+    # the lane encoding — rich host models are rejected with TypeError.
+    with pytest.raises(TypeError, match="TensorModel"):
         BinaryClock().checker().threads(4).spawn_bfs()
     with pytest.raises(NotImplementedError, match="single-threaded"):
         BinaryClock().checker().threads(2).spawn_dfs()

@@ -7,7 +7,6 @@ encoding to capture the FULL host state identity, including the
 linearizability tester's thread histories and real-time snapshots.
 """
 
-import os
 
 import pytest
 
@@ -41,15 +40,12 @@ def test_c1_device_engine_matches():
     assert len(path.into_actions()) >= 1
 
 
-@pytest.mark.slow
-@pytest.mark.skipif(
-    not os.environ.get("STPU_SLOW"),
-    reason="several-minute CPU run; set STPU_SLOW=1 (covered on TPU by bench.py)",
-)
 def test_c2_device_engine_reference_golden():
     # The reference's headline golden: 16,668 unique states at 2 clients
     # (examples/paxos.rs:327), with an 8-step "value chosen" discovery
-    # (paxos.rs:330-340).
+    # (paxos.rs:330-340). Default-on since round 4: the era-loop engine +
+    # the persistent compilation cache make this affordable in CI (the
+    # round-3 block engine needed several minutes on CPU).
     twin = (
         TensorModelAdapter(PaxosTensorFull(2))
         .checker()
@@ -62,3 +58,39 @@ def test_c2_device_engine_reference_golden():
     path = twin.discovery("value chosen")
     assert path is not None
     assert len(path.into_actions()) == 8
+
+
+def test_c2_threaded_host_oracle_golden():
+    """The vectorized threaded host engine re-derives the reference golden
+    in under a second — the live oracle bench.py uses."""
+    twin = (
+        TensorModelAdapter(PaxosTensorFull(2))
+        .checker()
+        .threads(4)
+        .spawn_bfs()
+        .join()
+    )
+    assert twin.unique_state_count() == 16_668
+    assert twin.discovery("linearizable") is None
+
+
+def test_c2_sharded_engine_agrees():
+    """Single-device and sharded engines must agree on the paxos golden
+    (the scale-capability criterion: the same program that runs paxos-3 on
+    one chip shards over the mesh)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    twin = (
+        TensorModelAdapter(PaxosTensorFull(2))
+        .checker()
+        .spawn_sharded_bfs(
+            devices=jax.devices()[:4],
+            chunk_size=256,
+            queue_capacity_per_shard=1 << 15,
+            table_capacity_per_shard=1 << 15,
+        )
+        .join()
+    )
+    assert twin.unique_state_count() == 16_668

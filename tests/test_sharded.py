@@ -85,3 +85,60 @@ def test_sharded_matches_single_device_engine(devices):
         .join()
     )
     assert sharded.unique_state_count() == single.unique_state_count()
+
+
+def test_sharded_checkpoint_resume_golden(tmp_path):
+    """Kill/resume on the 8-shard mesh: a target-capped run checkpoints
+    (including per-shard rings, spill lists, and take_caps); a fresh
+    checker resumes it to the exact full-space golden."""
+    import jax
+
+    from stateright_tpu.models import TwoPhaseTensor
+    from stateright_tpu.tensor import TensorModelAdapter
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    ck = str(tmp_path / "shard.npz")
+    devices = jax.devices()[:8]
+    opts = dict(
+        devices=devices,
+        chunk_size=64,
+        queue_capacity_per_shard=1 << 11,
+        table_capacity_per_shard=1 << 10,
+    )
+    part = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .target_state_count(3000)
+        .spawn_sharded_bfs(checkpoint_path=ck, **opts)
+        .join()
+    )
+    assert part.unique_state_count() < 8832
+    resumed = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_sharded_bfs(resume_from=ck, **opts)
+        .join()
+    )
+    assert resumed.unique_state_count() == 8832, resumed.unique_state_count()
+    assert resumed.discovery("consistent") is None
+
+
+def test_sharded_checkpoint_rejects_mismatched_model(tmp_path):
+    import jax
+
+    from stateright_tpu.models import TwoPhaseTensor
+    from stateright_tpu.tensor import TensorModelAdapter
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    ck = str(tmp_path / "shard.npz")
+    devices = jax.devices()[:4]
+    opts = dict(devices=devices, chunk_size=64)
+    TensorModelAdapter(TwoPhaseTensor(4)).checker().target_state_count(
+        500
+    ).spawn_sharded_bfs(checkpoint_path=ck, **opts).join()
+    with pytest.raises(ValueError):
+        TensorModelAdapter(TwoPhaseTensor(5)).checker().spawn_sharded_bfs(
+            resume_from=ck, **opts
+        ).join()

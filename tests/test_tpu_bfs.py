@@ -128,3 +128,26 @@ def test_rejects_rich_models_and_visitors():
         TensorModelAdapter(IncrementTensor(2)).checker().visitor(
             lambda p: None
         ).spawn_tpu_bfs()
+
+
+def test_telemetry_surfaces_engine_gauges():
+    """Engine health (eras, steps, load factor, take_cap) must be visible
+    through the public Checker.telemetry()/report surface, not just
+    STPU_DEBUG (reference report.rs:66-74 role)."""
+    import io
+
+    from stateright_tpu.models import TwoPhaseTensor
+    from stateright_tpu.report import WriteReporter
+    from stateright_tpu.tensor import TensorModelAdapter
+
+    c = TensorModelAdapter(TwoPhaseTensor(4)).checker().spawn_tpu_bfs(
+        chunk_size=256
+    )
+    buf = io.StringIO()
+    c.report(WriteReporter(buf))
+    t = c.telemetry()
+    assert t["eras"] >= 1
+    assert t["steps"] >= 1
+    assert 0 < t["load_factor"] < 1
+    assert t["take_cap"] >= 1
+    assert "Telemetry." in buf.getvalue()
