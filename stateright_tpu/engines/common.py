@@ -153,3 +153,64 @@ class HostEngineBase(Checker):
 
     def _finish_matched(self, discoveries: Dict[str, Any]) -> bool:
         return self._finish_when.matches(set(discoveries), self._properties)
+
+
+# -- checkpoint metadata (shared by the device engines) ----------------------
+
+FP_VER = 2  # round-4 decorrelated hash pair (fingerprint.py mix note)
+
+
+def checkpoint_meta(tm, tprops, **fields) -> dict:
+    """Common identity header for engine checkpoints: fingerprint version,
+    model class + parameter digest, and property set — a resumed table is
+    only meaningful for the exact model, properties, and hash that wrote
+    it. Engine-specific fields are passed through."""
+    meta = {
+        "fp_ver": FP_VER,
+        "model": f"{type(tm).__module__}.{type(tm).__qualname__}",
+        "model_config": tm.config_digest(),
+        "prop_names": [p.name for p in tprops],
+        "state_width": tm.state_width,
+    }
+    meta.update(fields)
+    return meta
+
+
+def validate_checkpoint_meta(meta: dict, tm, tprops, exact: dict) -> None:
+    """Reject a checkpoint whose identity or layout does not match this
+    checker. `exact` maps field name -> required value (qcap, n_shards,
+    chunk, quota, ...); every listed field must match exactly."""
+    if meta.get("fp_ver") != FP_VER:
+        raise ValueError(
+            "checkpoint was written with a different fingerprint hash "
+            f"version ({meta.get('fp_ver')!r} != {FP_VER}); its table keys "
+            "are incompatible"
+        )
+    this_model = f"{type(tm).__module__}.{type(tm).__qualname__}"
+    if meta.get("model") != this_model:
+        raise ValueError(
+            f"checkpoint was written by model {meta.get('model')!r}; "
+            f"resuming it with {this_model!r} would silently produce wrong "
+            "results"
+        )
+    if meta.get("model_config") != tm.config_digest():
+        raise ValueError(
+            f"checkpoint was written with model config "
+            f"{meta.get('model_config')!r}; this instance has "
+            f"{tm.config_digest()!r} — same-width different-parameter "
+            "models must not share a visited table"
+        )
+    this_props = [p.name for p in tprops]
+    if meta.get("prop_names") != this_props:
+        raise ValueError(
+            f"checkpoint property set {meta.get('prop_names')} does not "
+            f"match this checker's {this_props}; rec_fp/rec_bits would "
+            "misalign"
+        )
+    for field, want in exact.items():
+        if meta.get(field) != want:
+            raise ValueError(
+                f"checkpoint {field}={meta.get(field)!r} does not match "
+                f"this checker's {want!r}; resume with matching engine "
+                "options"
+            )

@@ -855,27 +855,22 @@ class TpuBfsChecker(HostEngineBase):
         restart from scratch (SURVEY.md §5)."""
         import json
 
-        meta = {
-            "head": head,
-            "count": count,
-            "rec_bits": rec_bits,
-            "state_count": self._state_count,
-            "unique": self._unique,
-            "max_depth": self._max_depth,
-            "tcap": self._tcap,
-            "qcap": self._qcap,
-            "chunk": self._chunk,
-            "state_width": self.tm.state_width,
-            # Model identity: a resumed table/ring is only meaningful for
-            # the exact model and property set that produced it; a
-            # same-width different model would silently yield wrong results.
-            "model": f"{type(self.tm).__module__}.{type(self.tm).__qualname__}",
-            "model_config": self.tm.config_digest(),
-            "prop_names": [p.name for p in self._tprops],
-            "discovery_fps": {
-                k: str(v) for k, v in self._discovery_fps.items()
-            },
-        }
+        from .common import checkpoint_meta
+
+        meta = checkpoint_meta(
+            self.tm,
+            self._tprops,
+            head=head,
+            count=count,
+            rec_bits=rec_bits,
+            state_count=self._state_count,
+            unique=self._unique,
+            max_depth=self._max_depth,
+            tcap=self._tcap,
+            qcap=self._qcap,
+            chunk=self._chunk,
+            discovery_fps={k: str(v) for k, v in self._discovery_fps.items()},
+        )
         arrays = {
             "meta": np.frombuffer(
                 json.dumps(meta).encode(), dtype=np.uint8
@@ -900,35 +895,19 @@ class TpuBfsChecker(HostEngineBase):
 
         import jax.numpy as jnp
 
+        from .common import validate_checkpoint_meta
+
         data = np.load(path)
         meta = json.loads(bytes(data["meta"]).decode())
-        if meta["qcap"] != self._qcap or meta["state_width"] != self.tm.state_width:
-            raise ValueError(
-                "checkpoint was written with a different queue capacity or "
-                "model encoding; resume with matching engine options"
-            )
-        ckpt_model = meta.get("model")
-        this_model = f"{type(self.tm).__module__}.{type(self.tm).__qualname__}"
-        if ckpt_model is not None and ckpt_model != this_model:
-            raise ValueError(
-                f"checkpoint was written by model {ckpt_model!r}; resuming it "
-                f"with {this_model!r} would silently produce wrong results"
-            )
-        ckpt_cfg = meta.get("model_config")
-        this_cfg = self.tm.config_digest()
-        if ckpt_cfg is not None and ckpt_cfg != this_cfg:
-            raise ValueError(
-                f"checkpoint was written with model config {ckpt_cfg!r}; this "
-                f"instance has {this_cfg!r} — same-width different-parameter "
-                "models must not share a visited table"
-            )
-        ckpt_props = meta.get("prop_names")
-        this_props = [p.name for p in self._tprops]
-        if ckpt_props is not None and ckpt_props != this_props:
-            raise ValueError(
-                f"checkpoint property set {ckpt_props} does not match this "
-                f"checker's {this_props}; rec_fp/rec_bits would misalign"
-            )
+        validate_checkpoint_meta(
+            meta,
+            self.tm,
+            self._tprops,
+            exact={
+                "qcap": self._qcap,
+                "state_width": self.tm.state_width,
+            },
+        )
         self._tcap = meta["tcap"]
         self._state_count = meta["state_count"]
         self._unique = meta["unique"]

@@ -161,26 +161,49 @@ def fingerprint(value: Any) -> int:
 # Vectorized word-stream hashing for tensor states (numpy + jax twins).
 # ---------------------------------------------------------------------------
 
-def _hash_words_generic(xp, words, seed):
+# The two fingerprint halves MUST be structurally independent mixes, not
+# the same mix with different seeds: a seed-only difference leaves the
+# halves correlated on structured model states, and the pair degrades far
+# below 64 effective bits. Measured on the real 2pc-7 space (round 4): the
+# seed-only variant produced 8 h1-collisions among 296,448 states — the
+# expected birthday rate for 32 bits — but ONE of those eight ALSO collided
+# in h2, i.e. the "64-bit" fingerprint behaved like ~35 bits and silently
+# merged two distinct states (the long-standing 296,447 "golden" was this
+# bug). h2 therefore absorbs the words in REVERSE order with different
+# multipliers and a different rotation; after the fix the full space has
+# zero pair collisions and the h1-only collisions remain at the normal
+# 32-bit rate.
+_H1 = (17, _PRIME3, _PRIME4, _PRIME2, _PRIME3)  # rot, mul, post, fin1, fin2
+_H2 = (13, _PRIME2, _PRIME5, _PRIME4, _PRIME5)
+
+
+def _absorb(xp, word_iter, base_shape, S, seed, params):
+    rot, mul, post, fin1, fin2 = params
+    acc = xp.zeros(base_shape, dtype=xp.uint32)
+    acc = acc + xp.uint32(seed) + xp.uint32(_PRIME5) + xp.uint32(S * 4)
+    for w in word_iter:
+        acc = acc + w * xp.uint32(mul)
+        acc = (acc << xp.uint32(rot)) | (acc >> xp.uint32(32 - rot))
+        acc = acc * xp.uint32(post)
+    acc = acc ^ (acc >> xp.uint32(15))
+    acc = acc * xp.uint32(fin1)
+    acc = acc ^ (acc >> xp.uint32(13))
+    acc = acc * xp.uint32(fin2)
+    acc = acc ^ (acc >> xp.uint32(16))
+    return acc
+
+
+def _hash_words_generic(xp, words, seed, params=_H1, reverse=False):
     """xxhash32-style mix over the trailing axis of a uint32 array.
 
     words: [..., S] uint32 -> [...] uint32. Identical results for xp=numpy
     and xp=jax.numpy; all arithmetic wraps mod 2**32.
     """
     S = words.shape[-1]
-    acc = xp.full(words.shape[:-1], 0, dtype=xp.uint32)
-    acc = acc + xp.uint32(seed) + xp.uint32(_PRIME5) + xp.uint32(S * 4)
-    for i in range(S):
-        w = words[..., i]
-        acc = acc + w * xp.uint32(_PRIME3)
-        acc = (acc << xp.uint32(17)) | (acc >> xp.uint32(15))
-        acc = acc * xp.uint32(_PRIME4)
-    acc = acc ^ (acc >> xp.uint32(15))
-    acc = acc * xp.uint32(_PRIME2)
-    acc = acc ^ (acc >> xp.uint32(13))
-    acc = acc * xp.uint32(_PRIME3)
-    acc = acc ^ (acc >> xp.uint32(16))
-    return acc
+    order = range(S - 1, -1, -1) if reverse else range(S)
+    return _absorb(
+        xp, (words[..., i] for i in order), words.shape[:-1], S, seed, params
+    )
 
 
 def hash_words_np(words: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -192,7 +215,7 @@ def hash_words_np(words: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     words = np.asarray(words, dtype=np.uint32)
     with np.errstate(over="ignore"):
         h1 = _hash_words_generic(np, words, SEED1)
-        h2 = _hash_words_generic(np, words, SEED2)
+        h2 = _hash_words_generic(np, words, SEED2, _H2, reverse=True)
     both_zero = (h1 == 0) & (h2 == 0)
     h2 = np.where(both_zero, np.uint32(1), h2)
     return h1, h2
@@ -204,13 +227,13 @@ def hash_words_jnp(words):
 
     words = words.astype(jnp.uint32)
     h1 = _hash_words_generic(jnp, words, int(SEED1))
-    h2 = _hash_words_generic(jnp, words, int(SEED2))
+    h2 = _hash_words_generic(jnp, words, int(SEED2), _H2, reverse=True)
     both_zero = (h1 == 0) & (h2 == 0)
     h2 = jnp.where(both_zero, jnp.uint32(1), h2)
     return h1, h2
 
 
-def _hash_lanes_generic(xp, lanes, seed):
+def _hash_lanes_generic(xp, lanes, seed, params=_H1, reverse=False):
     """Same mix as `_hash_words_generic`, but over a sequence of 1-D lane
     arrays (structure-of-arrays layout) instead of the trailing axis of one
     2-D array. lanes[i][n] == words[n, i] implies identical hashes — the two
@@ -221,18 +244,8 @@ def _hash_lanes_generic(xp, lanes, seed):
     (a [N, S] row layout with small S wastes the 8x128 vector tiles).
     """
     S = len(lanes)
-    acc = xp.zeros(lanes[0].shape, dtype=xp.uint32)
-    acc = acc + xp.uint32(seed) + xp.uint32(_PRIME5) + xp.uint32(S * 4)
-    for w in lanes:
-        acc = acc + w * xp.uint32(_PRIME3)
-        acc = (acc << xp.uint32(17)) | (acc >> xp.uint32(15))
-        acc = acc * xp.uint32(_PRIME4)
-    acc = acc ^ (acc >> xp.uint32(15))
-    acc = acc * xp.uint32(_PRIME2)
-    acc = acc ^ (acc >> xp.uint32(13))
-    acc = acc * xp.uint32(_PRIME3)
-    acc = acc ^ (acc >> xp.uint32(16))
-    return acc
+    seq = reversed(lanes) if reverse else lanes
+    return _absorb(xp, seq, lanes[0].shape, S, seed, params)
 
 
 def hash_lanes_np(lanes) -> tuple[np.ndarray, np.ndarray]:
@@ -240,7 +253,7 @@ def hash_lanes_np(lanes) -> tuple[np.ndarray, np.ndarray]:
     lanes = [np.asarray(l, dtype=np.uint32) for l in lanes]
     with np.errstate(over="ignore"):
         h1 = _hash_lanes_generic(np, lanes, SEED1)
-        h2 = _hash_lanes_generic(np, lanes, SEED2)
+        h2 = _hash_lanes_generic(np, lanes, SEED2, _H2, reverse=True)
     both_zero = (h1 == 0) & (h2 == 0)
     h2 = np.where(both_zero, np.uint32(1), h2)
     return h1, h2
@@ -252,7 +265,7 @@ def hash_lanes_jnp(lanes):
 
     lanes = [l.astype(jnp.uint32) for l in lanes]
     h1 = _hash_lanes_generic(jnp, lanes, int(SEED1))
-    h2 = _hash_lanes_generic(jnp, lanes, int(SEED2))
+    h2 = _hash_lanes_generic(jnp, lanes, int(SEED2), _H2, reverse=True)
     both_zero = (h1 == 0) & (h2 == 0)
     h2 = jnp.where(both_zero, jnp.uint32(1), h2)
     return h1, h2

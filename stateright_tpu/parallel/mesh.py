@@ -672,25 +672,25 @@ class ShardedBfsChecker(HostEngineBase):
         import os
         import time as _time
 
-        meta = {
-            "n_shards": self.n_shards,
-            "qcap": self._qcap,
-            "tcap": self._tcap,
-            "chunk": self._chunk,
-            "quota": self._quota,
-            "state_width": self.tm.state_width,
-            "model": f"{type(self.tm).__module__}.{type(self.tm).__qualname__}",
-            "model_config": self.tm.config_digest(),
-            "prop_names": [p.name for p in self._tprops],
-            "rec_bits": rec_bits,
-            "state_count": self._state_count,
-            "unique": self._unique,
-            "max_depth": self._max_depth,
-            "discovery_fps": {k: str(v) for k, v in self._discovery_fps.items()},
-            "disc_depth_best": {k: int(v) for k, v in disc_depth_best.items()},
-            "per_shard_unique": [int(u) for u in per_shard_unique],
-            "take_caps": [int(t) for t in take_caps],
-        }
+        from ..engines.common import checkpoint_meta
+
+        meta = checkpoint_meta(
+            self.tm,
+            self._tprops,
+            n_shards=self.n_shards,
+            qcap=self._qcap,
+            tcap=self._tcap,
+            chunk=self._chunk,
+            quota=self._quota,
+            rec_bits=rec_bits,
+            state_count=self._state_count,
+            unique=self._unique,
+            max_depth=self._max_depth,
+            discovery_fps={k: str(v) for k, v in self._discovery_fps.items()},
+            disc_depth_best={k: int(v) for k, v in disc_depth_best.items()},
+            per_shard_unique=[int(u) for u in per_shard_unique],
+            take_caps=[int(t) for t in take_caps],
+        )
         arrays = {
             "meta": np.frombuffer(
                 json.dumps(meta).encode(), dtype=np.uint8
@@ -717,33 +717,25 @@ class ShardedBfsChecker(HostEngineBase):
 
         import jax.numpy as jnp
 
+        from ..engines.common import validate_checkpoint_meta
+
         data = np.load(path)
         meta = json.loads(bytes(data["meta"]).decode())
-        if (
-            meta["n_shards"] != self.n_shards
-            or meta["qcap"] != self._qcap
-            or meta["state_width"] != self.tm.state_width
-        ):
-            raise ValueError(
-                "checkpoint was written with a different shard count, queue "
-                "capacity, or model encoding; resume with matching options"
-            )
-        this_model = f"{type(self.tm).__module__}.{type(self.tm).__qualname__}"
-        if meta["model"] != this_model:
-            raise ValueError(
-                f"checkpoint was written by model {meta['model']!r}; resuming "
-                f"it with {this_model!r} would silently produce wrong results"
-            )
-        if meta["model_config"] != self.tm.config_digest():
-            raise ValueError(
-                "checkpoint model config does not match this instance"
-            )
-        this_props = [p.name for p in self._tprops]
-        if meta["prop_names"] != this_props:
-            raise ValueError(
-                f"checkpoint property set {meta['prop_names']} does not "
-                f"match this checker's {this_props}"
-            )
+        validate_checkpoint_meta(
+            meta,
+            self.tm,
+            self._tprops,
+            exact={
+                "n_shards": self.n_shards,
+                "qcap": self._qcap,
+                "state_width": self.tm.state_width,
+                # The exchange program and spill headroom are compiled
+                # around these; a silent mismatch would change behavior
+                # mid-run.
+                "chunk": self._chunk,
+                "quota": self._quota,
+            },
+        )
         self._tcap = meta["tcap"]
         self._state_count = meta["state_count"]
         self._unique = meta["unique"]

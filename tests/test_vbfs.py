@@ -108,3 +108,15 @@ def test_rich_host_models_rejected():
 
     with pytest.raises((TypeError, NotImplementedError)):
         BinaryClock().checker().threads(4).spawn_bfs()
+
+
+def test_tpc7_exact_row_golden():
+    """2pc-7's TRUE count is 296,448 — derived by exact-row-identity BFS,
+    independent of any fingerprint hash. (Rounds 1-3 reported 296,447: one
+    64-bit pair collision under the old correlated hash halves silently
+    merged two distinct states.) The fingerprint-based engines must now
+    agree with the exact count."""
+    vec = (
+        TensorModelAdapter(TwoPhaseTensor(7)).checker().threads(8).spawn_bfs().join()
+    )
+    assert vec.unique_state_count() == 296_448, vec.unique_state_count()
