@@ -35,6 +35,17 @@ Duplicate keys *within* a batch therefore need no separate dedup pass:
 the claim protocol guarantees exactly one winner per distinct key, and
 `is_new` counts each distinct new key exactly once.
 
+PALLAS NOTE (round 4, measured on this platform): a hand-written Pallas
+probe kernel was prototyped and is NOT viable here. Pallas itself works
+(basic elementwise kernels compile and run), but TPU Pallas rejects
+vector dynamic indexing into a ref ("Cannot do int indexing on TPU"), so
+the open-addressing probe's random gathers cannot be expressed inside a
+kernel — they must go through XLA's native gather, which is exactly what
+this module does. The insert's cost is dependent-gather latency
+(~65ns/element at rcap widths, chained per probe round), a bound a kernel
+could only beat with scatter/gather DMA primitives TPU Pallas does not
+expose for this access pattern.
+
 The probe loops are COUNTED fori loops in two phases: a short full-width
 phase resolves the overwhelming majority, then the rare stragglers are
 cumsum-compacted into a narrow tail batch that probes further. Two

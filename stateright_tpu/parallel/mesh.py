@@ -7,8 +7,11 @@ Design (SURVEY.md §7 step 4, §5 "distributed communication backend"):
     fingerprint; four [N, cap] uint32 lanes (structure-of-arrays, see
     ops/visited_set.py), sharded on dim 0,
   - frontier: per-shard ring lanes [N, qcap], holding only owned states,
-  - per block (ONE shard_map'ed jitted program, counted fori loop — the
-    same remote-TPU dispatch constraints as engines/tpu_bfs.py apply):
+  - per era (ONE shard_map'ed jitted program, a device-resident while
+    loop whose predicate is a GLOBALLY UNIFORM gate — one stacked psum
+    per step yields work-left / congestion / probe-error / finish-policy
+    discovery bits, identical on every shard; same design as
+    engines/tpu_bfs.py's era loop):
       each shard pops a chunk, evaluates properties, expands successors,
       buckets the candidates BY OWNER into fixed per-destination quotas,
       and exchanges them with `lax.all_to_all` — each candidate crosses
@@ -21,8 +24,9 @@ Design (SURVEY.md §7 step 4, §5 "distributed communication backend"):
     delivered candidates are inserted+enqueued (idempotent), the pops are
     NOT consumed, and a per-shard take_cap halves until everything fits.
 
-The host syncs once per block: one [N, P_LEN] stats download, then spill /
-growth / finish-policy decisions. Cross-shard discovery paths reconstruct
+The host syncs once per era: one [N, P_LEN] stats download, then spill /
+growth / finish-policy decisions (discovery-finish already exits the era
+on device). Cross-shard discovery paths reconstruct
 on the host by walking parent pointers across the downloaded table shards
 (owner = h1 % N per hop).
 """
@@ -55,8 +59,11 @@ P_GEN = 8
 P_MAXD = 9
 P_STEPS = 10
 P_ERR = 11
-P_TAKE_CAP = 12  # persisted across blocks (self-tuned on bucket overflow)
-P_LEN = 13
+P_TAKE_CAP = 12  # persisted across eras (self-tuned on bucket overflow)
+P_FIN_ANY = 13  # era exits when (global rec & fin_any) != 0
+P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
+P_FIN_ALL_EN = 15
+P_LEN = 16
 
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
@@ -105,8 +112,46 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         depth_limit = params[P_DEPTH_LIMIT]
         max_steps = params[P_MAX_STEPS]
         rec_bits = params[P_REC]
+        fin_any = params[P_FIN_ANY]
+        fin_all = params[P_FIN_ALL]
+        fin_all_en = params[P_FIN_ALL_EN]
 
-        def body(_i, carry):
+        def global_gates(count, unique, err_cnt, hseen, rec_acc0, its):
+            """One stacked psum produces every exit condition, IDENTICAL on
+            all shards (the while predicate must be uniform): work left,
+            congestion (a shard cannot refuse all_to_all deliveries, so no
+            shard may pop while ANY shard's ring or table is within one
+            step's receive of its limit), probe errors, and the finish
+            policy's GLOBAL discovery bits."""
+            local = [
+                (count > u(0)).astype(u),
+                ((count > high_water) | (unique > grow_limit)).astype(u),
+                (err_cnt > u(0)).astype(u),
+            ] + [
+                jnp.minimum(hseen[pi].sum(dtype=u), u(1)) for pi in range(NP_)
+            ]
+            g = lax.psum(jnp.stack(local), axis)
+            rec_acc = rec_acc0
+            for pi in range(NP_):
+                rec_acc = rec_acc | (
+                    jnp.minimum(g[3 + pi], u(1)) << u(pi)
+                )
+            fin_hit = ((rec_acc & fin_any) != u(0)) | (
+                (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
+            )
+            g_cont = (
+                (g[0] > u(0))
+                & (g[1] == u(0))
+                & (g[2] == u(0))
+                & ~fin_hit
+                & (its < max_steps)
+            ).astype(u)
+            return g_cont
+
+        def cond(carry):
+            return carry[-1] != u(0)  # carried uniform gate
+
+        def body(carry):
             (
                 table,
                 queue,
@@ -121,17 +166,10 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 facc1,
                 facc2,
                 faccd,
+                its,
+                _g_cont,
             ) = carry
-            # GLOBAL congestion gate: a shard cannot refuse all_to_all
-            # deliveries (they are already inserted in its table), so no
-            # shard may pop while ANY shard's ring or table is within one
-            # step's worth (N*quota) of its limit — that bounds every
-            # shard's receives to exactly the headroom the limits reserve.
-            congested = lax.psum(
-                ((count > high_water) | (unique > grow_limit)).astype(u),
-                axis,
-            )
-            pred = (count > 0) & (congested == u(0))
+            pred = count > 0
             take = jnp.where(
                 pred, jnp.minimum(jnp.minimum(count, u(chunk)), take_cap), u(0)
             )
@@ -227,9 +265,11 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 facc2 = tuple(facc2_n)
                 faccd = tuple(faccd_n)
 
+            its = its + u(1)
+            g_cont = global_gates(count, unique, err_cnt, hseen, rec_bits, its)
             return (
                 table, queue, head, count, unique, gen, steps, err_cnt,
-                take_cap, hseen, facc1, facc2, faccd,
+                take_cap, hseen, facc1, facc2, faccd, its, g_cont,
             )
 
         zero_lane = jnp.zeros(chunk, dtype=u) + (params[0] & u(0))
@@ -237,6 +277,14 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         # Scalars seeded from varying data so carry types stay consistent
         # under shard_map (constants would be unvarying on the mesh axis).
         vzero = params[0] & u(0)
+        g0 = global_gates(
+            params[P_COUNT],
+            params[P_UNIQUE],
+            vzero,
+            tuple(false_lane for _ in range(NP_)),
+            rec_bits,
+            vzero,
+        )
         init = (
             table,
             queue,
@@ -251,11 +299,13 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             tuple(zero_lane for _ in range(NP_)),
             tuple(zero_lane for _ in range(NP_)),
             tuple(zero_lane for _ in range(NP_)),
+            vzero,  # iteration counter (uniform: every shard runs lockstep)
+            g0,
         )
         (
             table, queue, head, count, unique, gen, steps, err_cnt,
-            take_cap_out, hseen, facc1, facc2, faccd,
-        ) = lax.fori_loop(u(0), max_steps, body, init)
+            take_cap_out, hseen, facc1, facc2, faccd, _its, _gc,
+        ) = lax.while_loop(cond, body, init)
 
         # Block epilogue (once per block): BLOCK-LOCAL discovery reports.
         # The host keeps the min-depth discovery across blocks and shards —
@@ -286,6 +336,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 head, count, unique, rec_bits_out, depth_limit, grow_limit,
                 high_water, max_steps, gen, maxd, steps,
                 (err_cnt > 0).astype(u), take_cap_out,
+                fin_any, fin_all, fin_all_en,
             ]
         )
 
@@ -333,7 +384,7 @@ class ShardedBfsChecker(HostEngineBase):
         chunk_size: int = 1024,
         queue_capacity_per_shard: int = 1 << 16,
         table_capacity_per_shard: int = 1 << 18,
-        sync_steps: int = 64,
+        sync_steps: int = 4096,
         checkpoint_path: Optional[str] = None,
         checkpoint_every: Optional[float] = None,
         resume_from: Optional[str] = None,
@@ -444,7 +495,7 @@ class ShardedBfsChecker(HostEngineBase):
             return self._run_loop(
                 table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
                 take_caps, disc_depth_best, per_shard_unique, depth_limit,
-                self._qcap - N * self._quota, 4, W,
+                self._qcap - N * self._quota, W,
             )
 
         inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
@@ -500,20 +551,19 @@ class ShardedBfsChecker(HostEngineBase):
         # The per-step append is bounded by the receive width.
         high_water = self._qcap - N * self._quota
         rec_bits = 0
-        sync_steps = 4
         take_caps = [self._chunk] * N
         disc_depth_best: Dict[str, int] = {}
         per_shard_unique = self._per_shard_uniques(table_np)
         return self._run_loop(
             table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
             take_caps, disc_depth_best, per_shard_unique, depth_limit,
-            high_water, sync_steps, W,
+            high_water, W,
         )
 
     def _run_loop(
         self, table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
         take_caps, disc_depth_best, per_shard_unique, depth_limit,
-        high_water, sync_steps, W,
+        high_water, W,
     ) -> None:
         import time as _time
 
@@ -526,25 +576,50 @@ class ShardedBfsChecker(HostEngineBase):
         A = tm.max_actions
         C = self._chunk
         N = self.n_shards
+        max_sync = (
+            self._max_sync_steps
+            if self._timeout is None and self._ckpt_every is None
+            else min(64, self._max_sync_steps)
+        )
+        fin_any, fin_all, fin_all_en = self._finish_when.device_masks(
+            self._tprops
+        )
+        # Spill hysteresis (see engines/tpu_bfs.py): drain to / refill up
+        # to a margin below the watermark so spilling runs still get long
+        # eras between host round-trips.
+        spill_target = max(high_water // 2, high_water - 64 * N * self._quota)
 
         while counts.sum() > 0 or any(self._spill[s] for s in range(N)):
-            # Refill spills per shard.
+            # Refill spills per shard (one batched upload per shard).
             for s in range(N):
-                while (
-                    self._spill[s]
-                    and counts[s] + len(self._spill[s][-1]) <= high_water
+                refill = []
+                refill_rows = 0
+                # Spill blocks are <= N*quota rows and spill_target >=
+                # 1.5*N*quota (qcap >= 4*N*quota in __init__), so an empty
+                # shard always refills at least one block.
+                while self._spill[s] and (
+                    counts[s] + refill_rows + len(self._spill[s][-1])
+                    <= spill_target
                 ):
-                    rows = self._spill[s].pop()
+                    refill.append(self._spill[s].pop())
+                    refill_rows += len(refill[-1])
+                if refill:
+                    rows = np.concatenate(refill, axis=0)
                     k = len(rows)
                     idx = jnp.asarray(
                         (heads[s] + counts[s] + np.arange(k)) & (self._qcap - 1)
                     )
+                    rows_dev = jnp.asarray(rows)
                     queue = tuple(
-                        queue[t].at[s, idx].set(jnp.asarray(rows[:, t]))
+                        queue[t].at[s, idx].set(rows_dev[:, t])
                         for t in range(W)
                     )
                     counts[s] += k
             if counts.sum() == 0:
+                if any(self._spill[s] for s in range(N)):
+                    # Unreachable by the block-size invariant above; loud
+                    # beats silently dropping spilled states.
+                    raise RuntimeError("empty frontier with stranded spill")
                 break
 
             # Grow ALL shard tables together when any shard nears the load
@@ -558,7 +633,7 @@ class ShardedBfsChecker(HostEngineBase):
                 0, int(vs.MAX_LOAD * self._tcap) - N * self._quota
             )
 
-            max_steps = sync_steps
+            max_steps = max_sync
             if self._target_state_count is not None:
                 remaining = max(
                     0, self._target_state_count - self._state_count
@@ -573,6 +648,7 @@ class ShardedBfsChecker(HostEngineBase):
                     heads[s], counts[s], per_shard_unique[s], rec_bits,
                     depth_limit, grow_limit, high_water, max_steps,
                     0, 0, 0, 0, take_caps[s],
+                    fin_any, fin_all, fin_all_en,
                 ]
             table, queue, rec_fp1, rec_fp2, params, disc_depth = self._block(
                 table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
@@ -590,8 +666,6 @@ class ShardedBfsChecker(HostEngineBase):
             self._unique = int(sum(per_shard_unique))
             self._state_count += int(vals[:, P_GEN].sum())
             self._max_depth = max(self._max_depth, int(vals[:, P_MAXD].max()))
-            if int(vals[:, P_STEPS].max()) >= max_steps:
-                sync_steps = min(sync_steps * 2, self._max_sync_steps)
 
             block_bits = int(np.bitwise_or.reduce(vals[:, P_REC]))
             if block_bits:
@@ -613,22 +687,25 @@ class ShardedBfsChecker(HostEngineBase):
                         )
                 rec_bits |= block_bits
 
-            # Per-shard spill.
+            # Per-shard spill: drain to the hysteresis margin, ONE stacked
+            # download per shard.
             for s in range(N):
-                while counts[s] > high_water:
-                    k = int(min(N * self._quota, counts[s] - high_water))
+                if counts[s] > high_water:
+                    k = int(counts[s] - spill_target)
                     idx = jnp.asarray(
                         (heads[s] + counts[s] - k + np.arange(k))
                         & (self._qcap - 1)
                     )
-                    block = np.stack(
-                        [np.asarray(queue[t][s, idx]) for t in range(W)],
-                        axis=1,
+                    big = np.asarray(
+                        jnp.stack(
+                            [queue[t][s, idx] for t in range(W)], axis=1
+                        )
                     )
-                    self._spill[s].append(block)
+                    for off in range(0, k, N * self._quota):
+                        self._spill[s].append(big[off : off + N * self._quota])
                     counts[s] -= k
                     self._max_depth = max(
-                        self._max_depth, int(block[:, S + 3].max())
+                        self._max_depth, int(big[:, S + 3].max())
                     )
 
             if self._ckpt_path is not None and (
