@@ -237,7 +237,7 @@ def main() -> None:
     # result.
     px3 = PaxosTensorExhaustive(3)
     opts3 = dict(
-        chunk_size=4096, queue_capacity=1 << 20, table_capacity=1 << 26
+        chunk_size=16384, queue_capacity=1 << 21, table_capacity=1 << 26
     )
     TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()  # compile
     t0 = time.perf_counter()

@@ -36,7 +36,7 @@ from typing import List
 
 import numpy as np
 
-from .tensor import TensorModel
+from .tensor import TensorModel, TensorProperty
 
 _PAY_BITS = 20
 PAY_MASK = (1 << _PAY_BITS) - 1
@@ -168,6 +168,24 @@ class ActorNetModel(TensorModel):
         for m in range(self.K):
             acc = acc | fn(lanes[self.n_actor_lanes + m])
         return acc
+
+    def net_capacity_property(self):
+        """An always-property guarding the in-flight bound K.
+
+        The sorted ring keeps zeros (empty slots) first, so slot 0 being
+        nonzero means all K slots are occupied — one more send would
+        silently drop the smallest envelope. K bounds are derived from the
+        protocol and validated against actor-model goldens; this property
+        turns a bound violation into a LOUD counterexample instead of a
+        silent state-space corruption, which is what makes empirically
+        tightened bounds (state width and step arithmetic scale with K and
+        K^2) safe to use. Include it in `tensor_properties()`."""
+        NB = self.n_actor_lanes
+
+        def within_capacity(xp, lanes):
+            return lanes[NB] == xp.uint32(0)
+
+        return TensorProperty.always("network within capacity", within_capacity)
 
     def step_lanes(self, xp, lanes):
         u = xp.uint32

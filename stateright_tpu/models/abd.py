@@ -289,6 +289,7 @@ class AbdTensor(ActorNetModel):
         return [
             TensorProperty.always("linearizable", self.linearizable_lanes),
             TensorProperty.sometimes("value chosen", value_chosen),
+            self.net_capacity_property(),
         ]
 
     # -- display ------------------------------------------------------------

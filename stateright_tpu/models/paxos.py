@@ -24,7 +24,7 @@ register-linearizability verdict per state as a closed-form lane program
 the host model's backtracking-tester verdict (examples/paxos.rs:282-284
 parity; oracle-validated in tests/test_paxos_linearizable.py).
 
-Lane layout (S = 6 + c + K lanes, K = 14*c network slots):
+Lane layout (S = 6 + c + K lanes, K = 7*c network slots):
   lanes 0..5   server j: [2j] packed core, [2j+1] prepares map
   lanes 6..6+c-1 client i: phase | read value | real-time counters
   remaining K  network: sorted envelope words, 0 = empty (zeros first)
@@ -73,11 +73,17 @@ class PaxosTensor(ActorNetModel):
             raise ValueError("PaxosTensor supports at most 7 clients")
         self.c = client_count
         self.n_servers = 3
-        # Bound on simultaneously in-flight messages: every execution sends
-        # at most 4 client-protocol messages per client plus 10 internal
-        # messages per term, and terms <= client count (each Put is consumed
-        # at most once and only proposal-less servers start terms).
-        self.K = 14 * client_count
+        # Bound on simultaneously in-flight messages. Each client keeps at
+        # most ONE client-protocol message outstanding (Put/PutOk/Get/GetOk
+        # are strict request-response), and term-protocol messages proceed
+        # in rounds with at most two broadcast copies plus superseded-term
+        # stragglers in flight. Measured maxima over the FULL reachable
+        # space: 5 at c=1, 10 at c=2 (5 per client); K = 7c adds a 40%
+        # margin, and the "network within capacity" always-property turns
+        # any violation into a loud counterexample (rounds 1-3 used 14c:
+        # ~1.7x the state width and 4x the net-update arithmetic for
+        # nothing).
+        self.K = 7 * client_count
         self.n_actor_lanes = 6 + client_count
         self._net_base = self.n_actor_lanes
 
@@ -378,6 +384,7 @@ class PaxosTensor(ActorNetModel):
         return [
             TensorProperty.always("linearizable", self.linearizable_lanes),
             TensorProperty.sometimes("value chosen", value_chosen),
+            self.net_capacity_property(),
         ]
 
     # -- display ------------------------------------------------------------
