@@ -221,6 +221,30 @@ def main() -> None:
     )
     detail["ttfc_increment_race_secs"] = round(medt, 3)
 
+    # --- TTFC: single-copy-register 3x2 linearizability violation ----------
+    # bench.sh:32 workload family; a REAL protocol bug (stale/None read)
+    # found by the shared linearizable lane program on device.
+    from stateright_tpu.has_discoveries import HasDiscoveries
+    from stateright_tpu.models.single_copy import SingleCopyTensor
+
+    sct = SingleCopyTensor(3, 2)
+    scopts = dict(chunk_size=256, queue_capacity=1 << 12, table_capacity=1 << 12)
+    fin = HasDiscoveries.any_of(["linearizable"])
+
+    def mk_sc():
+        return (
+            TensorModelAdapter(sct)
+            .checker()
+            .finish_when(fin)
+            .spawn_tpu_bfs(**scopts)
+        )
+
+    mk_sc().join()  # compile
+    medsc, _spreadsc, _devsc = timed3(
+        mk_sc, check=lambda c: c.discovery("linearizable") is not None
+    )
+    detail["ttfc_single_copy_3x2_secs"] = round(medsc, 3)
+
     result = {
         "metric": "2pc-7 exhaustive check, generated states/sec "
         "(device engine, median of 3)",

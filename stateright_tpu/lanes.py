@@ -174,7 +174,12 @@ class ActorNetModel(TensorModel):
 
         The sorted ring keeps zeros (empty slots) first, so slot 0 being
         nonzero means all K slots are occupied — one more send would
-        silently drop the smallest envelope. K bounds are derived from the
+        silently drop the smallest envelope. Size K with at least ONE slot
+        of slack above the protocol's derived in-flight bound: a strict
+        request-response protocol legitimately SITS at its bound (e.g. the
+        single-copy register holds exactly c messages from the initial
+        state on), and a slack-free ring would trip this guard on every
+        reachable state. K bounds are derived from the
         protocol and validated against actor-model goldens; this property
         turns a bound violation into a LOUD counterexample instead of a
         silent state-space corruption, which is what makes empirically
@@ -337,6 +342,45 @@ def register_linearizable_lanes(xp, client_lanes):
     for i in range(c):
         cyclic = cyclic | (((adj[i] >> u(i)) & u(1)) == u(1))
     return ~(cyclic | none_read)
+
+
+def register_family_properties(model, getok_type: int = 4, val_shift: int = 4):
+    """The standard register-twin property list: the shared linearizable
+    lane program (always), a value-chosen scan over GetOk envelopes
+    (sometimes), and the network capacity guard. `val_shift` is the bit
+    offset of the 4-bit tester value code inside the GetOk payload
+    (1 = None, 2+k = writer k's value)."""
+
+    def value_chosen(xp, lanes):
+        u = xp.uint32
+
+        def is_value_getok(env):
+            return (
+                ((env >> u(28)) == u(getok_type))
+                & (((env >> u(val_shift)) & u(15)) != u(1))
+                & (env != u(0))
+            )
+
+        return model.net_scan(xp, lanes, is_value_getok)
+
+    return [
+        TensorProperty.always("linearizable", model.linearizable_lanes),
+        TensorProperty.sometimes("value chosen", value_chosen),
+        model.net_capacity_property(),
+    ]
+
+
+def decode_net(row, n_actor_base: int, K: int, type_names) -> List[str]:
+    """Human-readable network view (Explorer / error messages)."""
+    out = []
+    for m in range(K):
+        env = int(row[n_actor_base + m])
+        if env:
+            out.append(
+                f"{type_names[env >> 28]}({(env >> 24) & 15}->"
+                f"{(env >> 20) & 15}, pay={env & 0xFFFFF:#x})"
+            )
+    return out
 
 
 def decode_register_clients(row, n_actor_base: int, c: int) -> List[dict]:

@@ -10,8 +10,12 @@ from .fixtures import BinaryClock, DGraph, LinearEquation, Panicker
 from .two_phase_commit import TwoPhaseSys, TwoPhaseTensor
 from .increment import Increment, IncrementTensor
 from .increment_lock import IncrementLock, IncrementLockTensor
+from .abd import AbdTensor
+from .paxos import PaxosTensor
+from .single_copy import SingleCopyTensor
 
 __all__ = [
+    "AbdTensor",
     "BinaryClock",
     "DGraph",
     "Increment",
@@ -20,6 +24,8 @@ __all__ = [
     "IncrementTensor",
     "LinearEquation",
     "Panicker",
+    "PaxosTensor",
+    "SingleCopyTensor",
     "TwoPhaseSys",
     "TwoPhaseTensor",
 ]

@@ -40,9 +40,11 @@ import numpy as np
 
 from ..lanes import (
     ActorNetModel,
+    decode_net,
     decode_register_clients,
     env_word,
     register_client_deliver,
+    register_family_properties,
     register_linearizable_lanes,
 )
 from ..tensor import TensorProperty
@@ -276,21 +278,7 @@ class AbdTensor(ActorNetModel):
         )
 
     def tensor_properties(self) -> List[TensorProperty]:
-        def value_chosen(xp, lanes):
-            u = xp.uint32
-
-            def is_value_getok(env):
-                return ((env >> u(28)) == u(GETOK)) & (
-                    ((env >> u(4)) & u(15)) != u(1)
-                ) & (env != u(0))
-
-            return self.net_scan(xp, lanes, is_value_getok)
-
-        return [
-            TensorProperty.always("linearizable", self.linearizable_lanes),
-            TensorProperty.sometimes("value chosen", value_chosen),
-            self.net_capacity_property(),
-        ]
+        return register_family_properties(self, GETOK, val_shift=4)
 
     # -- display ------------------------------------------------------------
 
@@ -312,13 +300,9 @@ class AbdTensor(ActorNetModel):
                     "rid": (a >> _RID) & 15,
                 }
             )
-        net = []
-        for m in range(self.K):
-            env = int(row[self.n_actor_lanes + m])
-            if env:
-                net.append(
-                    f"{names[env >> 28]}({(env >> 24) & 15}->{(env >> 20) & 15},"
-                    f" pay={env & 0xFFFFF:#x})"
-                )
         clients = decode_register_clients(row, 4, self.c)
-        return {"servers": servers, "clients": clients, "net": net}
+        return {
+            "servers": servers,
+            "clients": clients,
+            "net": decode_net(row, self.n_actor_lanes, self.K, names),
+        }

@@ -1,0 +1,110 @@
+"""Single-copy-register tensor twin: the toolkit's violating protocol.
+
+With one server the system is linearizable; with two, a stale/None read
+breaks it (single-copy-register.rs goldens). This is the only register-
+family twin whose linearizable lane program FIRES on a real protocol, so
+it pins the violation-finding path end to end.
+"""
+
+import pytest
+
+from examples.single_copy_register import single_copy_model
+from stateright_tpu.has_discoveries import HasDiscoveries
+from stateright_tpu.models.single_copy import SingleCopyTensor
+from stateright_tpu.tensor import TensorModelAdapter
+
+_NEVER = HasDiscoveries.all_of(["<no such property>"])
+
+
+@pytest.mark.parametrize("c", [2, 3])
+def test_single_server_exhaustive_parity(c):
+    """s=1 is linearizable, so no property-set-dependent early stop: the
+    twin must match the actor model state-for-state to exhaustion
+    (93 uniques at c=2, single-copy-register.rs parity)."""
+    host = (
+        single_copy_model(c, 1).checker().finish_when(_NEVER).spawn_bfs().join()
+    )
+    twin = (
+        TensorModelAdapter(SingleCopyTensor(c, 1))
+        .checker()
+        .finish_when(_NEVER)
+        .spawn_bfs()
+        .join()
+    )
+    assert host.unique_state_count() == twin.unique_state_count()
+    if c == 2:
+        assert twin.unique_state_count() == 93
+    assert twin.discovery("linearizable") is None
+    assert host.discovery("linearizable") is None
+
+
+def test_two_servers_violation_found_by_all_engines():
+    """s=2: the None-read violation must be found by the actor model, the
+    twin's host engines, AND the device engine — with a replayable trace.
+    (Counts at stop are property-set/schedule dependent and are NOT
+    compared; the host engine halts once every property has a discovery,
+    and the twin carries an extra never-discovered capacity guard.)"""
+    host = single_copy_model(2, 2).checker().spawn_bfs().join()
+    assert host.discovery("linearizable") is not None
+
+    plain = TensorModelAdapter(SingleCopyTensor(2, 2)).checker().spawn_bfs().join()
+    t_plain = plain.discovery("linearizable")
+    assert t_plain is not None
+
+    vec = (
+        TensorModelAdapter(SingleCopyTensor(2, 2))
+        .checker()
+        .threads(4)
+        .spawn_bfs()
+        .join()
+    )
+    t_vec = vec.discovery("linearizable")
+    assert t_vec is not None
+    # BFS engines find a SHORTEST counterexample: lengths must agree.
+    assert len(t_vec.into_actions()) == len(t_plain.into_actions())
+
+    dev = (
+        TensorModelAdapter(SingleCopyTensor(2, 2))
+        .checker()
+        .spawn_tpu_bfs(chunk_size=128, queue_capacity=1 << 10, table_capacity=1 << 10)
+        .join()
+    )
+    t_dev = dev.discovery("linearizable")
+    assert t_dev is not None
+    assert len(t_dev.into_actions()) == len(t_plain.into_actions())
+
+
+def test_twin_engines_agree_exhaustively_at_two_servers():
+    """Under an identical never-matching policy and the twin's own property
+    set, all three twin engines enumerate the same space... except engines
+    still stop when every property is discovered; the capacity guard never
+    is, so these runs ARE exhaustive and comparable."""
+    counts = []
+    counts.append(
+        TensorModelAdapter(SingleCopyTensor(2, 2))
+        .checker()
+        .finish_when(_NEVER)
+        .spawn_bfs()
+        .join()
+        .unique_state_count()
+    )
+    counts.append(
+        TensorModelAdapter(SingleCopyTensor(2, 2))
+        .checker()
+        .finish_when(_NEVER)
+        .threads(4)
+        .spawn_bfs()
+        .join()
+        .unique_state_count()
+    )
+    counts.append(
+        TensorModelAdapter(SingleCopyTensor(2, 2))
+        .checker()
+        .finish_when(_NEVER)
+        .spawn_tpu_bfs(
+            chunk_size=128, queue_capacity=1 << 10, table_capacity=1 << 10
+        )
+        .join()
+        .unique_state_count()
+    )
+    assert counts[0] == counts[1] == counts[2], counts
