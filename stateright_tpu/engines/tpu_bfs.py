@@ -83,23 +83,27 @@ P_GEN = 8  # OUT: generated states this era
 P_MAXD = 9  # OUT: max depth seen this era
 P_STEPS = 10  # OUT: steps actually executed this era
 P_ERR = 11  # IN: pre-existing error (seed unresolved); OUT: >0 = probe budget exhausted
-P_TAKE_CAP = 12  # persisted across eras (self-tuned on rcap overflow)
+P_TAKE_CAP = 12  # persisted across eras (self-tuned on vcap overflow)
 P_FIN_ANY = 13  # era exits when (rec & fin_any) != 0
 P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
 P_FIN_ALL_EN = 15
 P_LEN = 16
 
 
-def _rcap(A: int, chunk: int) -> int:
-    """Probe-batch width for the visited-set insert.
+def _vcap(A: int, chunk: int) -> int:
+    """Compacted candidate-batch width (probe + enqueue width).
 
-    Sized for typical distinct-candidate counts; the take_cap mechanism
-    adapts when a model's step exceeds it. This is a SOUNDNESS-COUPLED
-    constant: the device loop treats it as the overflow threshold while
-    the host sizes grow_limit / pre-growth headroom from it — all sites
-    must use this one definition.
+    Every op downstream of validity compaction runs at this width, so it
+    bounds both the insert probe batch and the per-step enqueue. Sized for
+    typical valid-candidate counts (~20-40%% of the padded C*A batch for
+    the protocol models); the take_cap mechanism adapts when a model's
+    step exceeds it. This is a SOUNDNESS-COUPLED constant: the device
+    loop treats it as the overflow threshold while the host sizes
+    grow_limit / pre-growth headroom from it — all sites must use this
+    one definition.
     """
-    return max(64 * A, (chunk * A) // 8)
+    div = int(os.environ.get("STPU_VCAP_DIV", "3"))
+    return min(chunk * A, max(128 * A, (chunk * A) // div))
 
 
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
@@ -129,19 +133,17 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
     import jax.numpy as jnp
     from jax import lax
 
+    from ..fingerprint import hash_lanes_jnp
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
-    from ..ops.expand import build_eval_and_expand
+    from ..ops.expand import build_expand_lean
 
     S = tm.state_width
     A = tm.max_actions
     P = len(props)
-    eval_and_expand = build_eval_and_expand(tm, props, chunk)
+    expand_lean = build_expand_lean(tm, props, chunk)
     qmask = qcap - 1
-    rcap = _rcap(A, chunk)
-    # In-batch dedup scratch: ~2x the candidate width keeps distinct-key
-    # collisions (which retain duplicates, harmlessly) rare.
-    dedup_cap = 1 << max(1, (2 * chunk * A - 1).bit_length())
+    vcap = _vcap(A, chunk)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def loop(table, queue, rec_fp1, rec_fp2, params):
@@ -216,39 +218,47 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             active = jnp.arange(chunk, dtype=jnp.uint32) < take
             popped, _idx = fr.ring_gather(queue, head, chunk)
             rows = popped[:S]
-            row_h1 = popped[S]
-            row_h2 = popped[S + 1]
-            ebits = popped[S + 2]
-            depth = popped[S + 3]
+            ebits = popped[S]
+            depth = popped[S + 1]
+            # Fingerprints are recomputed on pop (elementwise — effectively
+            # free) instead of being carried in the ring: two fewer ring
+            # lanes in every ring gather/scatter, which ARE the cost here.
+            row_h1, row_h2 = hash_lanes_jnp(rows)
 
-            ex = eval_and_expand(
-                rows, row_h1, row_h2, ebits, depth, active, depth_limit
-            )
-            # In-batch pre-dedup: only first occurrences probe the visited
-            # table, and the insert probes a compacted [rcap] batch. On this
-            # platform dependent probe gathers are the dominant per-step
-            # cost (latency-bound; ~65ns/element at these widths), so probe
-            # traffic must scale with the number of distinct candidates,
-            # not the padded C*A batch width. The dedup itself is the cheap
-            # claim-based pass (approximate; the insert arbitrates
-            # leftovers exactly).
-            reps = fr.claim_dedup(ex.h1, ex.h2, ex.valid, dedup_cap)
-            table, is_new, unresolved, n_ovf = vs.insert(
-                table, ex.h1, ex.h2, ex.parent1, ex.parent2, reps, rcap=rcap
+            ex = expand_lean(rows, ebits, depth, active, depth_limit)
+            # COMPACT EARLY (the round-5 redesign): validity compaction is
+            # the only [C*A]-wide random-access work in the step. Hashing,
+            # parent lookup, the visited-set insert, and the ring append
+            # all run at the compacted [vcap] width. In-batch duplicate
+            # candidates need no separate dedup pass — the insert's claim
+            # protocol arbitrates them exactly (one winner per distinct
+            # key, same benign-race semantics as the reference's DashMap
+            # entry API, bfs.rs:302-315).
+            vids, vvalid, n_val = vs._compact_ids(ex.valid, vcap)
+            cl = tuple(ex.flat[s][vids] for s in range(S))
+            ch1, ch2 = hash_lanes_jnp(cl)
+            src = vids % u(chunk)  # parent row of candidate a*C+c is c
+            cp1 = jnp.where(vvalid, row_h1[src], u(0))
+            cp2 = jnp.where(vvalid, row_h2[src], u(0))
+            cebits = ex.ebits[src]
+            cdepth = depth[src] + u(1)
+            table, c_new, unresolved, _n_ovf = vs.insert(
+                table, ch1, ch2, cp1, cp2, vvalid
             )
             err_cnt = err_cnt + unresolved.sum(dtype=jnp.uint32)
-            new_count = is_new.sum(dtype=jnp.uint32)
+            new_count = c_new.sum(dtype=jnp.uint32)
 
-            # Overflow (> rcap distinct candidates) => PARTIAL step: the
-            # probed prefix is inserted and enqueued (inserts are
+            # Overflow (> vcap valid candidates) => PARTIAL step: the
+            # compacted prefix is inserted and enqueued (inserts are
             # idempotent and enqueue==inserted keeps them exactly-once),
             # but the pops are NOT consumed — the same parents re-expand
             # with a halved take_cap until everything fits. take_cap creeps
             # back up on success.
-            ovf = n_ovf > 0
-            cand = ex.flat + (ex.h1, ex.h2, ex.child_ebits, ex.child_depth)
+            ovf = n_val > u(vcap)
             tail = (head + count) & u(qmask)
-            queue = fr.ring_scatter(queue, tail, cand, is_new)
+            queue = fr.ring_scatter(
+                queue, tail, cl + (cebits, cdepth), c_new
+            )
 
             consumed = jnp.where(ovf, u(0), take)
             head = (head + consumed) & u(qmask)
@@ -360,7 +370,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             )
             rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
         maxd = jnp.where(
-            steps > 0, queue[S + 3][(head - u(1)) & u(qmask)], u(0)
+            steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
         params_out = jnp.stack(
             [
@@ -415,16 +425,16 @@ def _build_seed(S: int, qcap: int, tcap: int):
 
     from ..ops import visited_set as vs
 
-    W = S + 4
+    W = S + 2  # ring lanes: state | ebits | depth (hashes recomputed on pop)
 
     @jax.jit
-    def seed(qinit, params):
+    def seed(qinit, h1, h2, params):
         u = jnp.uint32
         n_init = qinit.shape[1]
         table = tuple(jnp.zeros(tcap, dtype=jnp.uint32) for _ in range(4))
         zero = jnp.zeros(n_init, dtype=jnp.uint32)
         table, is_new, unresolved, _ovf = vs.insert(
-            table, qinit[S], qinit[S + 1], zero, zero,
+            table, h1, h2, zero, zero,
             jnp.ones(n_init, bool),
         )
         queue = tuple(
@@ -553,7 +563,7 @@ class TpuBfsChecker(HostEngineBase):
         A = tm.max_actions
         C = self._chunk
         P = len(self._tprops)
-        W = S + 4  # queue lanes: state | h1 | h2 | ebits | depth
+        W = S + 2  # queue lanes: state | ebits | depth
 
         depth_limit = (
             self._target_max_depth
@@ -597,8 +607,8 @@ class TpuBfsChecker(HostEngineBase):
                 return
             if n_init > self._qcap:
                 raise ValueError("more initial states than queue capacity")
-            rcap = _rcap(A, C)
-            while n_init + rcap > vs.MAX_LOAD * self._tcap:
+            vcap = _vcap(A, C)
+            while n_init + vcap > vs.MAX_LOAD * self._tcap:
                 self._tcap *= 2
 
             # One upload (qinit rows + params template), zero downloads: the
@@ -609,10 +619,8 @@ class TpuBfsChecker(HostEngineBase):
             h1, h2 = hash_words_np(inits)
             qinit = np.zeros((W, n_init), dtype=np.uint32)
             qinit[:S] = inits.T
-            qinit[S] = h1
-            qinit[S + 1] = h2
-            qinit[S + 2] = self._init_ebits_tensor
-            qinit[S + 3] = 1
+            qinit[S] = self._init_ebits_tensor
+            qinit[S + 1] = 1
 
             max_steps0 = max_sync
             if self._target_state_count is not None:
@@ -629,13 +637,14 @@ class TpuBfsChecker(HostEngineBase):
             template[P_FIN_ALL] = fin_all
             template[P_FIN_ALL_EN] = fin_all_en
             template[P_GROW_LIMIT] = max(
-                0, int(vs.MAX_LOAD * self._tcap) - rcap
+                0, int(vs.MAX_LOAD * self._tcap) - vcap
             )
 
             _dbg("run: dispatching seeder")
             seed = _build_seed(S, self._qcap, self._tcap)
             table, queue, params_dev = seed(
-                jnp.asarray(qinit), jnp.asarray(template)
+                jnp.asarray(qinit), jnp.asarray(h1), jnp.asarray(h2),
+                jnp.asarray(template),
             )
             head = 0
             count = n_init
@@ -692,12 +701,12 @@ class TpuBfsChecker(HostEngineBase):
             # Proactive growth: guarantee the worst-case insert batch keeps
             # the load factor under vs.MAX_LOAD, so probe budgets can't be
             # exhausted (exhaustion would silently drop states).
-            rcap = _rcap(A, C)
-            while self._unique + rcap > vs.MAX_LOAD * self._tcap:
+            vcap = _vcap(A, C)
+            while self._unique + vcap > vs.MAX_LOAD * self._tcap:
                 table, self._tcap = self._grow_table(table)
                 self._telemetry["table_growths"] += 1
                 host_dirty = True
-            grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - rcap)
+            grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - vcap)
 
             max_steps = max_sync
             if self._target_state_count is not None:
@@ -802,7 +811,7 @@ class TpuBfsChecker(HostEngineBase):
                 # relies on — fold their depth in here. (Counts rows that are
                 # guaranteed to be visited unless the run stops early; a rare
                 # slight over-report beats a systematic under-report.)
-                self._max_depth = max(self._max_depth, int(big[:, S + 3].max()))
+                self._max_depth = max(self._max_depth, int(big[:, S + 1].max()))
                 params_dev = None  # host-side count changed; force re-upload
 
             if self._ckpt_path is not None and (
@@ -860,6 +869,7 @@ class TpuBfsChecker(HostEngineBase):
         meta = checkpoint_meta(
             self.tm,
             self._tprops,
+            ring_lanes=len(queue),
             head=head,
             count=count,
             rec_bits=rec_bits,
@@ -906,6 +916,9 @@ class TpuBfsChecker(HostEngineBase):
             exact={
                 "qcap": self._qcap,
                 "state_width": self.tm.state_width,
+                # Ring layout changed in round 5 (hashes no longer carried);
+                # checkpoints from the old layout must not load silently.
+                "ring_lanes": W,
             },
         )
         self._tcap = meta["tcap"]

@@ -43,6 +43,92 @@ class Expanded(NamedTuple):
     prop_hits: object  # list of P [C] bool masks (see module docstring)
 
 
+class ExpandedLean(NamedTuple):
+    ebits: object  # [C] uint32, post property evaluation
+    flat: object  # tuple of S lane arrays, each [C*A] (action-major)
+    valid: object  # [C*A] bool: action valid & in boundary & parent live
+    generated: object  # scalar uint32: number of valid candidates
+    prop_hits: object  # list of P [C] bool masks (see module docstring)
+
+
+def build_expand_lean(tm, props, chunk: int):
+    """The compact-early variant of `build_eval_and_expand` (round 5).
+
+    Returns f(rows, ebits, depth, active, depth_limit) -> ExpandedLean.
+
+    Rationale (measured on this platform, round 5): per-kernel launch
+    overhead is negligible, but EVERY random-access op costs ~7-14ns per
+    padded slot of its width — so the old contract, which materialized
+    fingerprints, parent tiles, and ebits/depth tiles at the padded [C*A]
+    width, made the engine pay the full padded width in a dozen wide ops
+    per step while only ~20% of slots are valid. This builder returns only
+    what is genuinely [C*A]-wide by nature (the successor lanes and the
+    validity mask); the engine compacts ONCE and derives hashes, parents,
+    and queue rows at the compacted width. Fingerprints of popped rows are
+    recomputed elementwise on pop instead of being carried in the ring —
+    elementwise work is effectively free here, ring lanes are not.
+
+    Semantics are identical to `build_eval_and_expand` (the reference hot
+    loop, bfs.rs:196-334): property evaluation with eventually-bit
+    clearing, depth limiting, boundary filtering, the terminal rule, and
+    terminal eventually-bit discoveries.
+    """
+    import jax.numpy as jnp
+
+    S = tm.state_width
+    A = tm.max_actions
+
+    def expand_lean(rows, ebits, depth, active, depth_limit):
+        u = jnp.uint32
+        live = active & (depth < depth_limit)
+
+        prop_hits = []
+        e_idx = 0
+        e_slot = {}
+        for i, p in enumerate(props):
+            if p.expectation == Expectation.EVENTUALLY:
+                vals = p.check(jnp, rows) & live
+                ebits = jnp.where(vals, ebits & ~u(1 << e_idx), ebits)
+                e_slot[i] = e_idx
+                e_idx += 1
+                prop_hits.append(None)
+                continue
+            if p.expectation == Expectation.ALWAYS:
+                prop_hits.append(live & ~p.check(jnp, rows))
+            else:  # SOMETIMES
+                prop_hits.append(live & p.check(jnp, rows))
+
+        succs, amask = tm.step_lanes(jnp, rows)
+        valid_per_a = []
+        any_valid = None
+        for a in range(A):
+            v = amask[a] & live & tm.within_boundary_lanes(jnp, succs[a])
+            valid_per_a.append(v)
+            any_valid = v if any_valid is None else (any_valid | v)
+        valid = jnp.concatenate(valid_per_a)  # [A*C], action-major
+        generated = valid.sum(dtype=u)
+
+        terminal = live & ~any_valid
+        for i, p in enumerate(props):
+            if p.expectation != Expectation.EVENTUALLY:
+                continue
+            bit = u(1 << e_slot[i])
+            prop_hits[i] = terminal & ((ebits & bit) != 0)
+
+        flat = tuple(
+            jnp.concatenate([succs[a][s] for a in range(A)]) for s in range(S)
+        )
+        return ExpandedLean(
+            ebits=ebits,
+            flat=flat,
+            valid=valid,
+            generated=generated,
+            prop_hits=prop_hits,
+        )
+
+    return expand_lean
+
+
 def build_eval_and_expand(tm, props, chunk: int):
     """Returns f(rows, row_h1, row_h2, ebits, depth, active, depth_limit)
     -> Expanded, where `rows` is a tuple of S [C] lane arrays.
