@@ -431,7 +431,7 @@ def _build_seed(S: int, qcap: int, tcap: int):
     def seed(qinit, h1, h2, params):
         u = jnp.uint32
         n_init = qinit.shape[1]
-        table = tuple(jnp.zeros(tcap, dtype=jnp.uint32) for _ in range(4))
+        table = vs.empty_table(tcap)
         zero = jnp.zeros(n_init, dtype=jnp.uint32)
         table, is_new, unresolved, _ovf = vs.insert(
             table, h1, h2, zero, zero,

@@ -85,18 +85,27 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec
 
+    from ..engines.tpu_bfs import _vcap
+    from ..fingerprint import hash_lanes_jnp
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
-    from ..ops.expand import build_eval_and_expand
+    from ..ops.expand import build_expand_lean
 
     S = tm.state_width
+    A = tm.max_actions
     NP_ = len(props)
-    eval_and_expand = build_eval_and_expand(tm, props, chunk)
+    expand_lean = build_expand_lean(tm, props, chunk)
     qmask = qcap - 1
-    X = S + 6  # exchanged lanes: state | h1 | h2 | p1 | p2 | ebits | depth
-    # In-batch dedup scratch (per shard): ~2x candidate width keeps
-    # distinct-key collisions (which harmlessly retain duplicates) rare.
-    dedup_cap = 1 << max(1, (2 * chunk * tm.max_actions - 1).bit_length())
+    X = S + 4  # exchanged lanes: state | p1 | p2 | ebits | depth — the
+    # candidate's own fingerprint is NOT exchanged; the owner recomputes it
+    # elementwise from the state lanes (elementwise work is free here,
+    # ICI lanes are not: this cuts exchange traffic by 2 lanes)
+    vcap = _vcap(A, chunk)
+    # Pre-exchange dedup scratch, at the COMPACTED width (round 5): in the
+    # sharded engine the dedup pass still earns its cost — every retained
+    # duplicate would cross the ICI to its owner before losing the claim
+    # there. Approximate as ever; the owner's insert arbitrates exactly.
+    dedup_cap = 1 << max(1, (2 * vcap - 1).bit_length())
 
     def per_device(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
@@ -176,42 +185,56 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             active = jnp.arange(chunk, dtype=u) < take
             popped, _ = fr.ring_gather(queue, head, chunk)
             rows = popped[:S]
-            row_h1 = popped[S]
-            row_h2 = popped[S + 1]
-            ebits = popped[S + 2]
-            depth = popped[S + 3]
+            ebits = popped[S]
+            depth = popped[S + 1]
+            # Recomputed on pop, elementwise (the ring no longer carries
+            # fingerprints — same round-5 redesign as engines/tpu_bfs.py).
+            row_h1, row_h2 = hash_lanes_jnp(rows)
 
-            ex = eval_and_expand(
-                rows, row_h1, row_h2, ebits, depth, active, depth_limit
+            ex = expand_lean(rows, ebits, depth, active, depth_limit)
+
+            # COMPACT EARLY: validity compaction is the only padded-width
+            # random-access op; hashing, dedup, bucketing, and the exchange
+            # all run at the compacted [vcap] width.
+            vids, vvalid, n_val = vs._compact_ids(ex.valid, vcap)
+            cl = tuple(ex.flat[s][vids] for s in range(S))
+            ch1, ch2 = hash_lanes_jnp(cl)
+            src = vids % u(chunk)
+            cp1 = jnp.where(vvalid, row_h1[src], u(0))
+            cp2 = jnp.where(vvalid, row_h2[src], u(0))
+            cebits = ex.ebits[src]
+            cdepth = depth[src] + u(1)
+
+            reps = fr.claim_dedup(ch1, ch2, vvalid, dedup_cap)
+            owner = ch1 % u(n_shards)
+
+            # Bucket by owner with ONE rank computation (no per-destination
+            # Python loop — program size stays flat in n_shards): a
+            # [vcap, N] one-hot cumsum yields each candidate's rank within
+            # its owner bucket and the per-owner counts in one pass.
+            onehot = (
+                owner[:, None] == jnp.arange(n_shards, dtype=u)[None, :]
+            ) & reps[:, None]
+            csum = jnp.cumsum(onehot.astype(u), axis=0)  # [vcap, N]
+            rank = (csum * onehot.astype(u)).sum(axis=1) - u(1)
+            counts_per_owner = csum[-1]  # [N]
+            n_ovf_total = (
+                counts_per_owner
+                - jnp.minimum(counts_per_owner, u(quota))
+            ).sum(dtype=u)
+            my = jnp.arange(vcap, dtype=u)
+            dest = jnp.where(
+                reps & (rank < u(quota)),
+                owner * u(quota) + rank,
+                u(n_shards * quota) + my,  # distinct drop targets
             )
-
-            # In-batch dedup before the exchange: only first occurrences
-            # travel (duplicates would just lose the claim at the owner).
-            # Claim-based and approximate — a scratch collision lets both
-            # copies travel, and the owner's insert arbitrates exactly; the
-            # lexsort this replaces dominated the per-step cost.
-            reps = fr.claim_dedup(ex.h1, ex.h2, ex.valid, dedup_cap)
-            owner = ex.h1 % u(n_shards)
-
-            # Bucket by owner into [n_shards * quota] send lanes.
-            cand = ex.flat + (
-                ex.h1, ex.h2, ex.parent1, ex.parent2, ex.child_ebits,
-                ex.child_depth,
-            )
-            n_ovf_total = u(0)
+            send_cand = cl + (cp1, cp2, cebits, cdepth)
             send = [
-                jnp.zeros(n_shards * quota, dtype=u) + (ex.h1[0] & u(0))
-                for _ in range(X)
+                jnp.zeros(n_shards * quota, dtype=u)
+                .at[dest]
+                .set(c, mode="drop", unique_indices=True)
+                for c in send_cand
             ]
-            for o in range(n_shards):
-                mask_o = reps & (owner == u(o))
-                ids, valid_o, n_o = vs._compact_ids(mask_o, quota)
-                n_ovf_total = n_ovf_total + n_o - jnp.minimum(n_o, u(quota))
-                for t in range(X):
-                    seg = cand[t][ids] * valid_o.astype(u)
-                    send[t] = lax.dynamic_update_slice(
-                        send[t], seg, (o * quota,)
-                    )
 
             # The ICI hop: one all_to_all per lane; each shard receives the
             # buckets addressed to it from every shard.
@@ -219,21 +242,21 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
                 for x in send
             ]
-            rh1 = recv[S]
-            rh2 = recv[S + 1]
-            r_valid = rh1 != u(0)  # fingerprints are nonzero as a pair; an
-            # all-zero exchanged slot means "empty"
-            r_valid = r_valid | (rh2 != u(0))
+            rstates = tuple(recv[t] for t in range(S))
+            rp1 = recv[S]
+            rp2 = recv[S + 1]
+            # Parent fingerprints are nonzero as a pair for every real
+            # candidate; an all-zero parent pair means "empty slot".
+            r_valid = (rp1 | rp2) != u(0)
+            rh1, rh2 = hash_lanes_jnp(rstates)  # owner-side recompute
 
             table, is_new, unresolved, _ovf_ins = vs.insert(
-                table, rh1, rh2, recv[S + 2], recv[S + 3], r_valid
+                table, rh1, rh2, rp1, rp2, r_valid
             )
             err_cnt = err_cnt + unresolved.sum(dtype=u)
             new_count = is_new.sum(dtype=u)
 
-            qrows = tuple(recv[t] for t in range(S)) + (
-                rh1, rh2, recv[S + 4], recv[S + 5]
-            )
+            qrows = rstates + (recv[S + 2], recv[S + 3])
             tail = (head + count) & u(qmask)
             queue = fr.ring_scatter(queue, tail, qrows, is_new)
 
@@ -329,7 +352,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             )
             rec_bits_out = rec_bits_out | (found.astype(u) << u(pi))
         maxd = jnp.where(
-            steps > 0, queue[S + 3][(head - u(1)) & u(qmask)], u(0)
+            steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
         params_out = jnp.stack(
             [
@@ -364,6 +387,62 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     )
     _LOOP_CACHE[key] = (tm, block)
     return block
+
+
+_GROW_CACHE: Dict[Tuple, Any] = {}
+
+
+def _build_grow(old_cap: int, new_cap: int, mesh, axis: str):
+    """Compile a shard_map'd per-shard rehash old_cap -> new_cap.
+
+    Runs entirely on device: each shard re-inserts its occupied rows into
+    a fresh table created in-program. Returns (new_table, unresolved[N]).
+    """
+    key = (old_cap, new_cap, tuple(id(d) for d in mesh.devices.flat))
+    cached = _GROW_CACHE.get(key)
+    if cached is not None:
+        return cached
+    while len(_GROW_CACHE) >= 8:
+        _GROW_CACHE.pop(next(iter(_GROW_CACHE)))
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+
+    from ..ops import visited_set as vs
+
+    def per_device(table):
+        import jax.numpy as jnp
+
+        shard = tuple(t[0] for t in table)
+        # Fresh tables seeded from varying input so their shard_map type is
+        # varying on the mesh axis (constant zeros would be unvarying and
+        # fail the rehash loop's carry typing).
+        vz = shard[0][0] & jnp.uint32(0)
+        empty = tuple(l + vz for l in vs.empty_table(new_cap))
+        new_table, unres = vs.rehash(shard, empty)
+        return (
+            tuple(jnp.expand_dims(l, 0) for l in new_table),
+            jnp.expand_dims(unres, 0),
+        )
+
+    spec = PartitionSpec(axis)
+    grow = jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=((spec,) * 4,),
+            out_specs=((spec,) * 4, spec),
+        ),
+        donate_argnums=(0,),
+    )
+
+    def run(table):
+        new_table, unres = grow(table)
+        return new_table, unres
+
+    _GROW_CACHE[key] = run
+    return run
 
 
 class ShardedBfsChecker(HostEngineBase):
@@ -472,7 +551,7 @@ class ShardedBfsChecker(HostEngineBase):
         C = self._chunk
         N = self.n_shards
         NP_ = len(self._tprops)
-        W = S + 4
+        W = S + 2  # ring lanes: state | ebits | depth
 
         if self._resume_from is not None:
             (
@@ -527,10 +606,8 @@ class ShardedBfsChecker(HostEngineBase):
             fp = combine64(h1[i], h2[i])
             row = queue_np[o, counts[o]]
             row[:S] = inits[i]
-            row[S] = h1[i]
-            row[S + 1] = h2[i]
-            row[S + 2] = self._init_ebits
-            row[S + 3] = 1
+            row[S] = self._init_ebits
+            row[S + 1] = 1
             counts[o] += 1
             if fp not in seen:
                 seen.add(fp)
@@ -705,7 +782,7 @@ class ShardedBfsChecker(HostEngineBase):
                         self._spill[s].append(big[off : off + N * self._quota])
                     counts[s] -= k
                     self._max_depth = max(
-                        self._max_depth, int(big[:, S + 3].max())
+                        self._max_depth, int(big[:, S + 1].max())
                     )
 
             if self._ckpt_path is not None and (
@@ -755,6 +832,7 @@ class ShardedBfsChecker(HostEngineBase):
             self.tm,
             self._tprops,
             n_shards=self.n_shards,
+            ring_lanes=len(queue),
             qcap=self._qcap,
             tcap=self._tcap,
             chunk=self._chunk,
@@ -811,6 +889,8 @@ class ShardedBfsChecker(HostEngineBase):
                 # mid-run.
                 "chunk": self._chunk,
                 "quota": self._quota,
+                # Ring layout changed in round 5 (hashes no longer carried).
+                "ring_lanes": W,
             },
         )
         self._tcap = meta["tcap"]
@@ -859,27 +939,17 @@ class ShardedBfsChecker(HostEngineBase):
         ]
 
     def _grow_tables(self, table):
-        """Double every shard's capacity; rehash on device per shard."""
-        import jax
-        import jax.numpy as jnp
-
-        from ..ops import visited_set as vs
-
+        """Double every shard's capacity with an ON-DEVICE shard_map'd
+        rehash — the table never round-trips through the host (round 5;
+        the old implementation downloaded, rehashed, and re-uploaded every
+        shard, a multi-GB host bounce at real table sizes)."""
         new_cap = self._tcap * 2
-        N = self.n_shards
-        old = [np.asarray(t) for t in table]  # [N, tcap] x 4
-        new_lanes = [np.zeros((N, new_cap), dtype=np.uint32) for _ in range(4)]
-        for s in range(N):
-            shard_old = tuple(jnp.asarray(old[t][s]) for t in range(4))
-            shard_new, unres = vs.rehash_jit(
-                shard_old, vs.empty_table(new_cap)
-            )
-            if int(unres) != 0:
-                raise RuntimeError("rehash failed; table pathologically full")
-            for t in range(4):
-                new_lanes[t][s] = np.asarray(shard_new[t])
+        grow = _build_grow(self._tcap, new_cap, self.mesh, "shards")
+        table, unres = grow(table)
+        if int(np.asarray(unres).sum()) != 0:
+            raise RuntimeError("rehash failed; table pathologically full")
         self._tcap = new_cap
-        return tuple(jnp.asarray(l) for l in new_lanes)
+        return table
 
     # -- accessors ----------------------------------------------------------
 
