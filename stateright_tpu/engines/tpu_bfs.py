@@ -269,7 +269,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             take_cap = jnp.where(
                 ovf,
                 jnp.maximum(take >> u(1), u(1)),
-                jnp.minimum(take_cap + u(max(1, chunk // 16)), u(chunk)),
+                jnp.minimum(take_cap + u(max(1, chunk // 64)), u(chunk)),
             )
 
             if P:

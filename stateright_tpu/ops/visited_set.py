@@ -66,14 +66,18 @@ import jax.numpy as jnp
 from jax import lax
 
 PRIMARY_ROUNDS = 2  # primary probe rounds (platform fast-path limit ~10/loop)
-# At MAX_LOAD=0.25, P(probe chain > 2) ~ 6%%; the narrow tail absorbs those.
+# At MAX_LOAD=0.25, P(probe chain > 2) ~ 6%; the narrow tail absorbs those.
 REHASH_ROUNDS = 8  # deeper primary phase for whole-table rehashes
-TAIL_ROUNDS = 8  # rounds per narrow tail stage
-TAIL_STAGES = 2  # stages after tail compaction
+# Tail stages run at the narrow TAIL_CAP width and are GATED on their
+# straggler count (lax.cond): a stage with nothing to do costs one scalar
+# reduction instead of its probe rounds. Total probe budget per insert is
+# PRIMARY (or REHASH) + sum(TAIL_STAGE_ROUNDS); stages engage
+# automatically as the load factor pushes chains longer.
+TAIL_STAGE_ROUNDS = (4, 12)
 # Lookups must probe at least as deep as the deepest possible placement:
-# a rehash insert can place a key up to REHASH_ROUNDS + TAIL_STAGES *
-# TAIL_ROUNDS probes along its sequence.
-MAX_PROBES = REHASH_ROUNDS + TAIL_STAGES * TAIL_ROUNDS
+# a rehash insert can place a key up to REHASH_ROUNDS + sum(tail) probes
+# along its sequence.
+MAX_PROBES = REHASH_ROUNDS + sum(TAIL_STAGE_ROUNDS)
 TAIL_CAP = 4096  # max stragglers carried into the tail phase
 # Probe chains stay within these budgets when the load factor stays under
 # MAX_LOAD (double hashing => geometric chains: P(len>3) ~ MAX_LOAD^3 per
@@ -99,9 +103,15 @@ def _probe_rounds(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, round
     k1, k2, v1, v2 = table
     capacity = k1.shape[0]
     mask = jnp.uint32(capacity - 1)
+    claim_cap = claim.shape[0]
+    cmask = jnp.uint32(claim_cap - 1)
     n = h1.shape[0]
     my_id = jnp.arange(n, dtype=jnp.uint32)
-    oob = jnp.uint32(capacity) + my_id  # distinct drop targets
+    # The claim scratch and the table have DIFFERENT sizes, so each needs
+    # its own out-of-bounds drop-target range (an index that is OOB for
+    # the claim would land INSIDE the larger table and corrupt it).
+    claim_oob = jnp.uint32(claim_cap) + my_id
+    table_oob = jnp.uint32(capacity) + my_id
 
     def body(_r, carry):
         k1, k2, v1, v2, claim, idx, done, is_new = carry
@@ -112,12 +122,20 @@ def _probe_rounds(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, round
         slot_empty = (rk1 == 0) & (rk2 == 0)
         want = ~done & slot_empty
         # Same-slot contenders intentionally collide here — the surviving
-        # write is the arbitration (no unique-indices promise).
-        claim = claim.at[jnp.where(want, idx, oob)].set(my_id, mode="drop")
-        won = want & (claim[idx] == my_id)
+        # write is the arbitration (no unique-indices promise). The claim
+        # scratch is a HASHED domain much smaller than the table (see
+        # `_claim_cap`): contenders for DIFFERENT table slots may collide
+        # on one claim slot, in which case all but one harmlessly lose and
+        # retry the same still-empty table slot next round — soundness
+        # never depends on the claim being collision-free, only on "claim
+        # readback == my id" being unforgeable within a round, which a
+        # per-candidate unique id guarantees.
+        ci = idx & cmask
+        claim = claim.at[jnp.where(want, ci, claim_oob)].set(my_id, mode="drop")
+        won = want & (claim[ci] == my_id)
         # Winner slots are unique; losers/dones get distinct out-of-bounds
         # targets so the unique-indices fast path stays valid.
-        tgt = jnp.where(won, idx, oob)
+        tgt = jnp.where(won, idx, table_oob)
         k1 = k1.at[tgt].set(h1, mode="drop", unique_indices=True)
         k2 = k2.at[tgt].set(h2, mode="drop", unique_indices=True)
         v1 = v1.at[tgt].set(p1, mode="drop", unique_indices=True)
@@ -188,10 +206,26 @@ def _probe_all(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
     # All-false but derived from varying data so the loop carry type stays
     # consistent under shard_map (constant zeros would be unvarying).
     t_new = t_valid & ~t_valid
-    for _stage in range(TAIL_STAGES):
-        table, claim, t_idx, t_done, t_new = _probe_rounds(
-            table, claim, th1, th2, tp1, tp2, t_stride, t_idx, t_done, t_new,
-            TAIL_ROUNDS,
+    for stage_rounds in TAIL_STAGE_ROUNDS:
+        # Gate each stage on its live straggler count: in the common case
+        # (low load) later stages have nothing to do and reduce to one
+        # scalar sum + a branch instead of stage_rounds probe rounds.
+        pending = (~t_done).sum(dtype=u)
+
+        def run_stage(op, stage_rounds=stage_rounds):
+            table, claim, t_idx, t_done, t_new = op
+            table, claim, t_idx, t_done, t_new = _probe_rounds(
+                table, claim, th1, th2, tp1, tp2, t_stride, t_idx, t_done,
+                t_new, stage_rounds,
+            )
+            return table, claim, t_idx, t_done, t_new
+
+        def skip_stage(op):
+            return op
+
+        table, claim, t_idx, t_done, t_new = lax.cond(
+            pending > u(0), run_stage, skip_stage,
+            (table, claim, t_idx, t_done, t_new),
         )
 
     # Fold tail results back into the full-width masks. Candidates that
@@ -234,6 +268,8 @@ def insert(table, h1, h2, p1, p2, active, rcap: int | None = None,
     # slots that were written earlier in the same round. Seeded from a
     # varying input (h1) so the carry type stays consistent under shard_map
     # (a constant-zeros init would be unvarying on the mesh axis).
+    # (A smaller hashed claim domain was tried in round 5 and measured
+    # SLOWER in situ despite touching less memory; table-width it stays.)
     claim = jnp.zeros(capacity, dtype=u) + (h1[0] & u(0))
 
     if rcap is None:
