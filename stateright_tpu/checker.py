@@ -138,6 +138,15 @@ class CheckerBuilder:
 
         return TpuBfsChecker(self, **kw)
 
+    def spawn_tpu_simulation(self, seed: int, **kw) -> "Checker":
+        """Batched device simulation over a TensorModel: B independent
+        seeded random walks advance one transition per device step
+        (engines/tpu_simulation.py; the data-parallel twin of the
+        reference's per-thread walks, simulation.rs:138-201)."""
+        from .engines.tpu_simulation import TpuSimulationChecker
+
+        return TpuSimulationChecker(self, seed, **kw)
+
     def spawn_sharded_bfs(self, **kw) -> "Checker":
         """The multi-device sharded BFS engine over a TensorModel.
 

@@ -27,7 +27,7 @@ class HostEngineBase(Checker):
     # engine's job. Engines that genuinely parallelize set this True.
     _supports_threads = False
 
-    def __init__(self, builder: CheckerBuilder):
+    def __init__(self, builder: CheckerBuilder, model=None):
         if builder.thread_count_ > 1 and not self._supports_threads:
             raise NotImplementedError(
                 f"{type(self).__name__} is single-threaded; "
@@ -35,7 +35,12 @@ class HostEngineBase(Checker):
                 "(CheckerBuilder.spawn_tpu_bfs). Drop .threads(n) or use the "
                 "device engine."
             )
-        self._model = builder.model
+        # `model` lets engines that wrap the builder's model (e.g. a raw
+        # TensorModel into its adapter) pass the WRAPPED model through
+        # without mutating the caller's builder — a builder constructed
+        # directly over a raw TensorModel must not crash in this base
+        # constructor on the raw object's missing Model API.
+        self._model = model if model is not None else builder.model
         self._properties = builder.model.properties()
         self._symmetry = builder.symmetry_fn_
         self._target_state_count = builder.target_state_count_

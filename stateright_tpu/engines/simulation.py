@@ -57,7 +57,18 @@ class UniformChooser(Chooser):
 
 
 class SimulationChecker(HostEngineBase):
-    """Reference: SimulationChecker::spawn, simulation.rs:95-211."""
+    """Reference: SimulationChecker::spawn, simulation.rs:95-211.
+
+    `.threads(n)` runs n concurrent workers, each with its own seed
+    stream — the reference's exact parallelism model (one independent
+    reseeded walk loop per thread, simulation.rs:138-201). Under CPython
+    the GIL serializes Python-level work, so this buys seed-stream
+    diversity and reference-parity semantics rather than wall-clock
+    speedup; the batched device engine (spawn_tpu_simulation) is the
+    throughput path.
+    """
+
+    _supports_threads = True
 
     def __init__(self, builder: CheckerBuilder, seed: int, chooser: Chooser):
         super().__init__(builder)
@@ -69,11 +80,32 @@ class SimulationChecker(HostEngineBase):
     # -- exploration --------------------------------------------------------
 
     def _run(self) -> None:
+        import threading
+
+        if self._thread_count <= 1:
+            return self._worker(0)
+        # Thread 0 keeps the caller's seed for its first trace; workers
+        # t>0 derive distinct streams (simulation.rs:150-156 hands each
+        # thread a distinct u64 from the spawn RNG).
+        workers = [
+            threading.Thread(target=self._worker, args=(t,), daemon=True)
+            for t in range(self._thread_count)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+
+    def _worker(self, tid: int) -> None:
         # Per-thread seed evolution mirrors simulation.rs:154-197: the first
-        # trace uses the caller's seed for reproducibility; subsequent trace
+        # trace uses the thread's seed for reproducibility; subsequent trace
         # seeds are drawn from a thread RNG seeded with the same value.
-        seed = self._seed
-        thread_rng = random.Random(self._seed)
+        seed = (
+            self._seed
+            if tid == 0
+            else random.Random((self._seed, tid)).getrandbits(64)
+        )
+        thread_rng = random.Random(seed)
         while True:
             self._check_trace_from_initial(seed)
             if self._finish_matched(self._discoveries):

@@ -245,16 +245,20 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             table, c_new, unresolved, _n_ovf = vs.insert(
                 table, ch1, ch2, cp1, cp2, vvalid
             )
-            err_cnt = err_cnt + unresolved.sum(dtype=jnp.uint32)
+            unres = unresolved.sum(dtype=jnp.uint32)
             new_count = c_new.sum(dtype=jnp.uint32)
 
-            # Overflow (> vcap valid candidates) => PARTIAL step: the
-            # compacted prefix is inserted and enqueued (inserts are
-            # idempotent and enqueue==inserted keeps them exactly-once),
-            # but the pops are NOT consumed — the same parents re-expand
-            # with a halved take_cap until everything fits. take_cap creeps
-            # back up on success.
-            ovf = n_val > u(vcap)
+            # Overflow (> vcap valid candidates, OR probe-tail overflow
+            # reported as unresolved candidates) => PARTIAL step: the
+            # inserted prefix is enqueued (inserts are idempotent and
+            # enqueue==inserted keeps them exactly-once), but the pops are
+            # NOT consumed — the same parents re-expand with a halved
+            # take_cap until everything fits/resolves. take_cap creeps
+            # back up on success. Unresolved candidates are only FATAL
+            # when the batch cannot shrink further (take == 1): that means
+            # genuinely exhausted probe chains, i.e. state loss.
+            err_cnt = err_cnt + jnp.where(take <= u(1), unres, u(0))
+            ovf = (n_val > u(vcap)) | (unres > u(0))
             tail = (head + count) & u(qmask)
             queue = fr.ring_scatter(
                 queue, tail, cl + (cebits, cdepth), c_new
@@ -480,7 +484,7 @@ class TpuBfsChecker(HostEngineBase):
                 "spawn_tpu_bfs requires a TensorModel (or its adapter); "
                 "rich host models must be encoded first — see stateright_tpu.tensor."
             )
-        super().__init__(builder)
+        super().__init__(builder, model=model)
         if self._visitor is not None:
             raise ValueError("the TPU engine does not support visitors")
         # Like the reference's BFS, symmetry reduction is a DFS-only feature
@@ -761,7 +765,16 @@ class TpuBfsChecker(HostEngineBase):
             if int(vals[11]):
                 # Cannot happen with the proactive growth above short of a
                 # pathological probe sequence; losing states would be an
-                # unsound "verified", so fail loudly.
+                # unsound "verified", so fail loudly. A nonzero error with
+                # ZERO steps on the first era means the unresolved count
+                # flowed in from the seeder (init-state insert), not the
+                # era loop — attribute it correctly.
+                if self._telemetry["eras"] == 0 and int(vals[10]) == 0:
+                    raise RuntimeError(
+                        "init-state seeding exhausted the visited-table "
+                        "probe budget (duplicate-heavy or adversarial "
+                        "initial fingerprints); raise table_capacity"
+                    )
                 raise RuntimeError(
                     "visited-table probe budget exhausted despite headroom"
                 )

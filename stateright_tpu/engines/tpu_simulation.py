@@ -1,0 +1,523 @@
+"""Batched device simulation: B independent seeded random walks per step.
+
+The device twin of the host simulation engine (engines/simulation.py;
+reference src/checker/simulation.rs:138-201, where parallelism = one
+independent seeded walk per OS thread). Here parallelism is data-parallel:
+B walks advance together, one random transition per walk per device step,
+inside the same era-loop architecture as the batched BFS engine (many
+steps per dispatch; the host syncs once per era).
+
+Design notes (TPU-first, not a translation):
+
+  - Walk state is structure-of-arrays: S state lanes of width [B], plus
+    per-walk seed / path-length / eventually-bits lanes.
+  - Each walk's fingerprint path lives in a device-resident [B, L] buffer
+    (L = walk_cap). That one structure serves THREE roles the reference
+    implements separately: per-run cycle detection (membership test is an
+    elementwise [B, L] compare — simulation.rs:285-289's HashSet), the
+    depth bound, and counterexample reporting (a discovery's full
+    fingerprint path is read straight out of the buffer — no replay).
+  - The chooser is a counter-based PRNG (splitmix-style avalanche of
+    (walk_seed, step)): stateless, so any walk's trace is reproducible
+    from the master seed alone, matching the reference's reseeded-
+    per-trace discipline (simulation.rs:154-197).
+  - Ended walks (terminal / cycle / depth-cap) restart IN PLACE with an
+    evolved seed; a walk that records a discovery freezes until the era
+    ends so its path buffer survives for extraction.
+
+Semantic divergences from the host engine (documented, both benign for
+the engine's purpose of finding examples/counterexamples fast):
+  - boundary handling: the device walk never *enters* an out-of-boundary
+    state (such successors are masked off as disabled), while the host
+    walk may select one and then end; walk distributions differ when a
+    boundary is active.
+  - the uniform chooser picks among actions whose successor is valid,
+    rather than retrying disabled actions without replacement — the same
+    distribution, computed without the swap_remove loop.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..checker import CheckerBuilder
+from ..core import Expectation
+from ..fingerprint import combine64
+from ..path import Path
+from ..tensor import TensorModel, TensorModelAdapter
+from .common import HostEngineBase
+
+# Packed scalar params (one uint32 vector per direction, as in tpu_bfs).
+P_REC = 0  # recorded-discovery bitmask
+P_MAX_STEPS = 1
+P_FIN_ANY = 2
+P_FIN_ALL = 3
+P_FIN_ALL_EN = 4
+P_TARGET_GEN = 5  # era exits when generated-this-run exceeds this (0 = off)
+P_GEN0 = 6  # generated before this era (for the target check)
+P_GEN = 7  # OUT: generated states total after era
+P_STEPS = 8  # OUT: device steps executed this era
+P_MAXD = 9  # OUT: max walk length seen
+P_LEN = 10
+
+_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
+
+
+def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
+    key = (id(tm), B, L, len(props))
+    cached = _LOOP_CACHE.get(key)
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+    while len(_LOOP_CACHE) >= 16:
+        _LOOP_CACHE.pop(next(iter(_LOOP_CACHE)))
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..fingerprint import hash_lanes_jnp
+
+    S = tm.state_width
+    A = tm.max_actions
+    P = len(props)
+
+    init_np = np.asarray(tm.init_states_array(), dtype=np.uint32)
+    n_init = len(init_np)
+    init_lanes_const = tuple(init_np[:, s] for s in range(S))
+
+    init_ebits = 0
+    e_slot = {}
+    e_idx = 0
+    for i, p in enumerate(props):
+        if p.expectation == Expectation.EVENTUALLY:
+            e_slot[i] = e_idx
+            init_ebits |= 1 << e_idx
+            e_idx += 1
+
+    def prng(x):
+        u = jnp.uint32
+        x = (x ^ (x >> u(16))) * u(0x7FEB352D)
+        x = (x ^ (x >> u(15))) * u(0x846CA68B)
+        return x ^ (x >> u(16))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def loop(walk, fp1buf, fp2buf, rec_fp1, rec_fp2, params):
+        """walk = (rows[S], seed, ptr, ebits) lanes of [B];
+        fp*buf = [B * L] flat path buffers."""
+        u = jnp.uint32
+        rec_bits0 = params[P_REC]
+        max_steps = params[P_MAX_STEPS]
+        fin_any = params[P_FIN_ANY]
+        fin_all = params[P_FIN_ALL]
+        fin_all_en = params[P_FIN_ALL_EN]
+        target_gen = params[P_TARGET_GEN]
+        gen0 = params[P_GEN0]
+        iota_b = jnp.arange(B, dtype=u)
+        iota_l = jnp.arange(L, dtype=u)
+        inits = tuple(jnp.asarray(l) for l in init_lanes_const)
+
+        def cond(carry):
+            (_w, _f1, _f2, gen, steps, rec_acc, _h, _p1, _p2, _pl, maxd) = carry
+            fin_hit = ((rec_acc & fin_any) != u(0)) | (
+                (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
+            )
+            under_target = (target_gen == u(0)) | (gen0 + gen < target_gen)
+            return (steps < max_steps) & ~fin_hit & under_target
+
+        def body(carry):
+            (
+                (rows, seed, ptr, ebits, frozen),
+                fp1buf,
+                fp2buf,
+                gen,
+                steps,
+                rec_acc,
+                hseen,
+                pf1,
+                pf2,
+                plen,
+                maxd,
+            ) = carry
+            active = ~frozen
+            h1, h2 = hash_lanes_jnp(rows)
+
+            # Cycle detection: membership of the current state in the
+            # walk's own path so far ([B, L] elementwise compare).
+            f1m = fp1buf.reshape(B, L)
+            f2m = fp2buf.reshape(B, L)
+            in_path = (
+                ((f1m == h1[:, None]) & (f2m == h2[:, None])
+                 & (iota_l[None, :] < ptr[:, None])).sum(axis=1, dtype=u)
+                > u(0)
+            )
+            cycle = active & in_path
+
+            # Record the current state into the path buffer.
+            pos = jnp.where(active & ~cycle, iota_b * u(L) + ptr, u(B * L) + iota_b)
+            fp1buf = fp1buf.at[pos].set(h1, mode="drop", unique_indices=True)
+            fp2buf = fp2buf.at[pos].set(h2, mode="drop", unique_indices=True)
+            counted = active & ~cycle
+            ptr = jnp.where(counted, ptr + u(1), ptr)
+            gen = gen + counted.sum(dtype=u)
+            # maxd is a PER-WALK lane, reduced once in the epilogue — a
+            # scalar max-reduce in the carry knocks the loop off the fast
+            # dispatch path on this platform (see engines/tpu_bfs.py).
+            maxd = jnp.maximum(maxd, ptr)
+
+            # Property evaluation on the current states (simulation.rs
+            # property loop; eventually-bits clear on satisfaction).
+            prop_hits = [None] * P
+            for i, p in enumerate(props):
+                if p.expectation == Expectation.EVENTUALLY:
+                    sat = p.check(jnp, rows) & counted
+                    ebits = jnp.where(sat, ebits & ~u(1 << e_slot[i]), ebits)
+                elif p.expectation == Expectation.ALWAYS:
+                    prop_hits[i] = counted & ~p.check(jnp, rows)
+                else:
+                    prop_hits[i] = counted & p.check(jnp, rows)
+
+            # Successors + enabled mask.
+            succs, amask = tm.step_lanes(jnp, rows)
+            valid_a = []
+            ne = jnp.zeros(B, dtype=u)
+            for a in range(A):
+                v = amask[a] & tm.within_boundary_lanes(jnp, succs[a])
+                valid_a.append(v)
+                ne = ne + v.astype(u)
+
+            terminal = counted & (ne == u(0))
+            capped = counted & (ptr >= u(L))
+            # Walk-end eventually discoveries (terminal rule; a cycle exit
+            # matches the host engine, which also falls through to the
+            # terminal ebits check on loops. Depth-capped walks skip it,
+            # like the host's target_max_depth path).
+            ended_for_ebits = terminal | cycle
+            for i, p in enumerate(props):
+                if p.expectation == Expectation.EVENTUALLY:
+                    prop_hits[i] = ended_for_ebits & (
+                        (ebits & u(1 << e_slot[i])) != u(0)
+                    )
+
+            # Discovery snapshots: first hit per property freezes its walk
+            # so the path buffer survives until the era ends.
+            newly_frozen = frozen & False
+            for i in range(P):
+                hits = prop_hits[i]
+                first = hits & ~hseen[i]
+                pf1 = tuple(
+                    jnp.where(first, h1, pf1[j]) if j == i else pf1[j]
+                    for j in range(P)
+                )
+                pf2 = tuple(
+                    jnp.where(first, h2, pf2[j]) if j == i else pf2[j]
+                    for j in range(P)
+                )
+                plen = tuple(
+                    jnp.where(first, ptr, plen[j]) if j == i else plen[j]
+                    for j in range(P)
+                )
+                hseen = tuple(
+                    (hseen[j] | hits) if j == i else hseen[j] for j in range(P)
+                )
+                rec_acc = rec_acc | (
+                    jnp.minimum(hits.sum(dtype=u), u(1)) << u(i)
+                )
+                newly_frozen = newly_frozen | first
+            frozen = frozen | newly_frozen
+
+            # Choose one enabled action uniformly (counter-based PRNG).
+            r = prng(seed ^ (ptr * u(0x9E3779B9)))
+            pick = jnp.where(ne > u(0), r % jnp.maximum(ne, u(1)), u(0))
+            cum = jnp.zeros(B, dtype=u)
+            new_rows = rows
+            chosen_any = ne < u(0)  # all-false, varying
+            for a in range(A):
+                sel = valid_a[a] & (cum == pick) & ~chosen_any
+                chosen_any = chosen_any | sel
+                new_rows = tuple(
+                    jnp.where(sel, succs[a][s], new_rows[s]) for s in range(S)
+                )
+                cum = cum + valid_a[a].astype(u)
+
+            advance = counted & ~terminal & ~capped & ~newly_frozen
+            restart = active & ~newly_frozen & (cycle | terminal | capped)
+
+            # Restarts: evolved seed, fresh init state, cleared path row.
+            seed2 = prng(seed + u(0x6A09E667))
+            init_pick = prng(seed2) % u(n_init)
+            rows = tuple(
+                jnp.where(
+                    restart,
+                    inits[s][init_pick],
+                    jnp.where(advance, new_rows[s], rows[s]),
+                )
+                for s in range(S)
+            )
+            seed = jnp.where(restart, seed2, seed)
+            ebits = jnp.where(restart, u(init_ebits), ebits)
+            keep_row = ~restart
+            fp1buf = (fp1buf.reshape(B, L) * keep_row[:, None]).reshape(-1)
+            fp2buf = (fp2buf.reshape(B, L) * keep_row[:, None]).reshape(-1)
+            ptr = jnp.where(restart, u(0), ptr)
+
+            steps = steps + u(1)
+            return (
+                (rows, seed, ptr, ebits, frozen),
+                fp1buf,
+                fp2buf,
+                gen,
+                steps,
+                rec_acc,
+                hseen,
+                pf1,
+                pf2,
+                plen,
+                maxd,
+            )
+
+        rows, seed, ptr, ebits = walk[:S], walk[S], walk[S + 1], walk[S + 2]
+        zero_b = seed & u(0)
+        false_b = zero_b != 0
+        init_carry = (
+            (tuple(rows), seed, ptr, ebits, false_b),
+            fp1buf,
+            fp2buf,
+            zero_b[0],
+            zero_b[0],
+            rec_bits0,
+            tuple(false_b for _ in range(P)),
+            tuple(zero_b for _ in range(P)),
+            tuple(zero_b for _ in range(P)),
+            tuple(zero_b for _ in range(P)),
+            zero_b,
+        )
+        (
+            (rows, seed, ptr, ebits, frozen),
+            fp1buf,
+            fp2buf,
+            gen,
+            steps,
+            rec_acc,
+            hseen,
+            pf1,
+            pf2,
+            plen,
+            maxd,
+        ) = lax.while_loop(cond, body, init_carry)
+
+        # Epilogue: per newly-hit property, report the SHORTEST hit's walk
+        # (parity with the BFS engine's shallowest-snapshot rule) as
+        # (walk_index, path_length, fp pair).
+        rec_bits_out = rec_bits0
+        disc_walk = jnp.zeros(P, dtype=u)
+        disc_plen = jnp.zeros(P, dtype=u)
+        for i in range(P):
+            found = jnp.any(hseen[i])
+            sel = jnp.argmin(jnp.where(hseen[i], plen[i], u(0xFFFFFFFF)))
+            take_new = found & (((rec_bits_out >> u(i)) & u(1)) == u(0))
+            rec_fp1 = rec_fp1.at[i].set(jnp.where(take_new, pf1[i][sel], rec_fp1[i]))
+            rec_fp2 = rec_fp2.at[i].set(jnp.where(take_new, pf2[i][sel], rec_fp2[i]))
+            disc_walk = disc_walk.at[i].set(sel.astype(u))
+            disc_plen = disc_plen.at[i].set(plen[i][sel])
+            rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
+
+        walk_out = tuple(rows) + (seed, ptr, ebits)
+        params_out = jnp.stack(
+            [
+                rec_bits_out,
+                params[P_MAX_STEPS],
+                params[P_FIN_ANY],
+                params[P_FIN_ALL],
+                params[P_FIN_ALL_EN],
+                params[P_TARGET_GEN],
+                gen0 + gen,
+                gen0 + gen,
+                steps,
+                maxd.max(),
+            ]
+        )
+        return walk_out, fp1buf, fp2buf, rec_fp1, rec_fp2, params_out, disc_walk, disc_plen
+
+    _LOOP_CACHE[key] = (tm, loop)
+    return loop
+
+
+class TpuSimulationChecker(HostEngineBase):
+    """B batched seeded random walks on the default JAX device."""
+
+    _supports_threads = True  # parallelism = the walk batch
+
+    def __init__(
+        self,
+        builder: CheckerBuilder,
+        seed: int,
+        *,
+        walks: int = 1024,
+        walk_cap: int = 256,
+        sync_steps: int = 1024,
+    ):
+        model = builder.model
+        if isinstance(model, TensorModel):
+            model = TensorModelAdapter(model)
+        if not isinstance(model, TensorModelAdapter):
+            raise TypeError(
+                "spawn_tpu_simulation requires a TensorModel (or its adapter)"
+            )
+        super().__init__(builder, model=model)
+        if self._visitor is not None:
+            raise ValueError("the device simulation engine does not support visitors")
+        if self._symmetry is not None:
+            raise ValueError(
+                "the device simulation engine does not support symmetry "
+                "reduction (use the host simulation engine)"
+            )
+        self.tm = model.tm
+        self._tprops = self.tm.tensor_properties()
+        if len(self._tprops) > 32:
+            raise ValueError("at most 32 tensor properties supported")
+        self._seed = seed & 0xFFFFFFFF
+        self._B = walks
+        self._L = (
+            min(walk_cap, self._target_max_depth)
+            if self._target_max_depth is not None
+            else walk_cap
+        )
+        self._sync = sync_steps
+        self._discovery_paths: Dict[str, List[int]] = {}
+        self._telemetry: Dict[str, Any] = {"eras": 0, "steps": 0, "restid": 0}
+        self._loop = _build_sim_loop(self.tm, self._tprops, self._B, self._L)
+        self._start()
+
+    @staticmethod
+    def _prng_np(x):
+        x = np.uint64(x) & np.uint64(0xFFFFFFFF)
+        x = np.uint32(x)
+        x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+        x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+        return x ^ (x >> np.uint32(16))
+
+    def _run(self) -> None:
+        import jax.numpy as jnp
+
+        tm = self.tm
+        S = tm.state_width
+        B, L, P = self._B, self._L, len(self._tprops)
+
+        fin_any, fin_all, fin_all_en = self._finish_when.device_masks(
+            self._tprops
+        )
+        init_ebits = 0
+        e = 0
+        for p in self._tprops:
+            if p.expectation == Expectation.EVENTUALLY:
+                init_ebits |= 1 << e
+                e += 1
+
+        inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
+        inb_lanes = tuple(inits[:, s] for s in range(S))
+        inb = np.asarray(tm.within_boundary_lanes(np, inb_lanes), dtype=bool)
+        inits = inits[inb]
+        if len(inits) == 0:
+            return
+
+        # Per-walk seeds derive from the master seed; walk 0 of the first
+        # batch uses the caller's seed directly (reproducibility parity
+        # with simulation.rs:154-156).
+        iota = np.arange(B, dtype=np.uint32)
+        seeds = self._prng_np(
+            np.uint32(self._seed) ^ (iota * np.uint32(0x9E3779B9))
+        )
+        seeds[0] = np.uint32(self._seed)
+        picks = self._prng_np(seeds) % np.uint32(len(inits))
+        rows0 = inits[picks]  # [B, S]
+
+        walk = tuple(jnp.asarray(rows0[:, s]) for s in range(S)) + (
+            jnp.asarray(seeds),
+            jnp.zeros(B, dtype=jnp.uint32),
+            jnp.full(B, init_ebits, dtype=jnp.uint32),
+        )
+        fp1buf = jnp.zeros(B * L, dtype=jnp.uint32)
+        fp2buf = jnp.zeros(B * L, dtype=jnp.uint32)
+        rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
+        rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
+        rec_bits = 0
+        gen_total = 0
+
+        max_sync = (
+            self._sync
+            if self._timeout is None
+            else min(64, self._sync)
+        )
+        target_gen = self._target_state_count or 0
+
+        params = np.zeros(P_LEN, dtype=np.uint32)
+        params[P_MAX_STEPS] = max_sync
+        params[P_FIN_ANY] = fin_any
+        params[P_FIN_ALL] = fin_all
+        params[P_FIN_ALL_EN] = fin_all_en
+        params[P_TARGET_GEN] = min(target_gen, 0xFFFFFFFF)
+        params_dev = jnp.asarray(params)
+
+        while True:
+            (
+                walk, fp1buf, fp2buf, rec_fp1, rec_fp2, params_dev,
+                disc_walk, disc_plen,
+            ) = self._loop(walk, fp1buf, fp2buf, rec_fp1, rec_fp2, params_dev)
+            vals = np.asarray(params_dev)
+            self._telemetry["eras"] += 1
+            self._telemetry["steps"] += int(vals[P_STEPS])
+            gen_total = int(vals[P_GEN])
+            self._state_count = gen_total
+            self._max_depth = max(self._max_depth, int(vals[P_MAXD]))
+
+            new_bits = int(vals[P_REC])
+            if new_bits != rec_bits:
+                # Extract the freshly-hit walks' fingerprint paths from the
+                # device buffers (one download per discovery era).
+                f1 = np.asarray(fp1buf).reshape(B, L)
+                f2 = np.asarray(fp2buf).reshape(B, L)
+                dw = np.asarray(disc_walk)
+                dp = np.asarray(disc_plen)
+                for i, p in enumerate(self._tprops):
+                    if not ((new_bits >> i) & 1) or p.name in self._discovery_paths:
+                        continue
+                    w = int(dw[i])
+                    n = int(dp[i])  # plen snapshots the post-write count
+                    chain = [
+                        combine64(int(f1[w, k]), int(f2[w, k]))
+                        for k in range(min(n, L))
+                    ]
+                    self._discovery_paths[p.name] = chain
+                rec_bits = new_bits
+
+            if self._finish_matched(self._discovery_paths):
+                return
+            if target_gen and gen_total >= target_gen:
+                return
+            if self._timed_out():
+                return
+
+    # -- accessors ----------------------------------------------------------
+
+    def telemetry(self) -> Dict[str, Any]:
+        t = dict(self._telemetry)
+        t["walks"] = self._B
+        t["walk_cap"] = self._L
+        return t
+
+    def unique_state_count(self) -> int:
+        # Like the host simulation engine: no global visited set is kept
+        # (simulation.rs:413-417).
+        return self._state_count
+
+    def discoveries(self) -> Dict[str, Path]:
+        self.join()
+        return {
+            name: Path.from_fingerprints(self._model, chain)
+            for name, chain in list(self._discovery_paths.items())
+        }

@@ -53,7 +53,7 @@ class VectorizedBfsChecker(HostEngineBase):
                 "TensorModel; rich host models run on the single-threaded "
                 "reference engine."
             )
-        super().__init__(builder)
+        super().__init__(builder, model=model)
         if self._visitor is not None:
             raise ValueError(
                 "the vectorized engine does not support visitors; use the "

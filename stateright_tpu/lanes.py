@@ -184,7 +184,19 @@ class ActorNetModel(TensorModel):
         turns a bound violation into a LOUD counterexample instead of a
         silent state-space corruption, which is what makes empirically
         tightened bounds (state width and step arithmetic scale with K and
-        K^2) safe to use. Include it in `tensor_properties()`."""
+        K^2) safe to use. Include it in `tensor_properties()`.
+
+        Detection-lag caveat (for protocols with max_sends > 1, e.g.
+        paxos): one slot of slack guarantees drop-BEFORE-detection cannot
+        happen only for single-send transitions. A delivery from a passing
+        state at occupancy K-1 that inserts multiple sends drops the
+        smallest envelope in the same transition that first trips this
+        guard, so the flagged counterexample state may already have lost
+        one envelope. The VERDICT is still sound (the violation is
+        detected loudly and the run never continues past it); only the
+        flagged state's network contents may be one drop stale. Sizing K
+        with max_sends slots of slack removes the lag at the cost of a
+        wider state."""
         NB = self.n_actor_lanes
 
         def within_capacity(xp, lanes):

@@ -78,7 +78,13 @@ TAIL_STAGE_ROUNDS = (4, 12)
 # a rehash insert can place a key up to REHASH_ROUNDS + sum(tail) probes
 # along its sequence.
 MAX_PROBES = REHASH_ROUNDS + sum(TAIL_STAGE_ROUNDS)
-TAIL_CAP = 4096  # max stragglers carried into the tail phase
+# Tail width: stragglers after the primary phase scale with the batch
+# (expected ~ n * load^PRIMARY_ROUNDS, approaching n/16 near MAX_LOAD), so
+# giant batches at high load CAN overflow this — overflow surfaces as
+# `unresolved` candidates, which engine callers must treat as RETRYABLE
+# (shrink the batch via the partial-commit take_cap protocol and redo;
+# inserts are idempotent), not as instant failure.
+TAIL_CAP = 4096
 # Probe chains stay within these budgets when the load factor stays under
 # MAX_LOAD (double hashing => geometric chains: P(len>3) ~ MAX_LOAD^3 per
 # candidate, and the tail phase absorbs the stragglers).
@@ -268,9 +274,13 @@ def insert(table, h1, h2, p1, p2, active, rcap: int | None = None,
     # slots that were written earlier in the same round. Seeded from a
     # varying input (h1) so the carry type stays consistent under shard_map
     # (a constant-zeros init would be unvarying on the mesh axis).
-    # (A smaller hashed claim domain was tried in round 5 and measured
-    # SLOWER in situ despite touching less memory; table-width it stays.)
-    claim = jnp.zeros(capacity, dtype=u) + (h1[0] & u(0))
+    # Capped at 2^22 entries: table-width up to there (a tightly hashed
+    # claim measured SLOWER in situ at these sizes), hashed beyond —
+    # giant tables (2pc-10 needs 2^28 slots) must not pay a 1GB memset
+    # plus table-width claim traffic per insert call. Aliased claim slots
+    # only cost a harmless retry round (see _probe_rounds).
+    claim_cap = min(capacity, 1 << 22)
+    claim = jnp.zeros(claim_cap, dtype=u) + (h1[0] & u(0))
 
     if rcap is None:
         stride = h2 | u(1)

@@ -253,7 +253,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             table, is_new, unresolved, _ovf_ins = vs.insert(
                 table, rh1, rh2, rp1, rp2, r_valid
             )
-            err_cnt = err_cnt + unresolved.sum(dtype=u)
+            unres = unresolved.sum(dtype=u)
             new_count = is_new.sum(dtype=u)
 
             qrows = rstates + (recv[S + 2], recv[S + 3])
@@ -261,7 +261,22 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             queue = fr.ring_scatter(queue, tail, qrows, is_new)
 
             # Partial-commit overflow protocol (see module docstring).
-            ovf = n_ovf_total > u(0)
+            # Probe-tail overflow (unresolved candidates at the OWNER) is
+            # retryable the same way, but the veto must be GLOBAL: the
+            # unresolved candidates' parents were popped on OTHER shards,
+            # so every shard must decline to consume and shrink its take
+            # (a sender cannot know which owner overflowed). Fatal only
+            # when no shard can shrink further — genuinely exhausted
+            # probe chains, i.e. state loss.
+            g_us = lax.psum(
+                jnp.stack([unres, (take > u(1)).astype(u)]), axis
+            )
+            g_unres = g_us[0]
+            g_can_shrink = g_us[1]
+            err_cnt = err_cnt + jnp.where(
+                g_can_shrink == u(0), g_unres, u(0)
+            )
+            ovf = (n_ovf_total > u(0)) | (g_unres > u(0))
             consumed = jnp.where(ovf, u(0), take)
             head = (head + consumed) & u(qmask)
             count = count - consumed + new_count
@@ -478,7 +493,7 @@ class ShardedBfsChecker(HostEngineBase):
             raise TypeError(
                 "spawn_sharded_bfs requires a TensorModel (or its adapter)"
             )
-        super().__init__(builder)
+        super().__init__(builder, model=model)
         if self._visitor is not None:
             raise ValueError("the sharded engine does not support visitors")
 

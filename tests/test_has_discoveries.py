@@ -1,0 +1,74 @@
+"""device_masks must agree with matches() for every policy and bitmask.
+
+ADVICE r4: the finish-policy lowering drives the on-device early-exit
+gate in both device engines; a mismatch against matches() would cause
+premature or missed era exits and was only caught indirectly by engine
+goldens. This exhaustively checks the predicate over every discovery
+bitmask for every policy kind, including the edge cases (all_of with a
+missing name, all_failures with zero failure-expectation properties).
+"""
+
+import itertools
+
+import pytest
+
+from stateright_tpu.core import Expectation
+from stateright_tpu.has_discoveries import HasDiscoveries
+
+
+class _Prop:
+    def __init__(self, name, expectation):
+        self.name = name
+        self.expectation = expectation
+
+
+def _prop_sets():
+    a = _Prop("always_ok", Expectation.ALWAYS)
+    s = _Prop("some_hit", Expectation.SOMETIMES)
+    e = _Prop("event_done", Expectation.EVENTUALLY)
+    a2 = _Prop("always_2", Expectation.ALWAYS)
+    yield [a, s, e]
+    yield [s]  # zero failure-expectation properties
+    yield [a, a2, e]  # zero sometimes
+    yield []
+
+
+def _policies(props):
+    names = [p.name for p in props]
+    yield HasDiscoveries.ALL
+    yield HasDiscoveries.ANY
+    yield HasDiscoveries.ANY_FAILURES
+    yield HasDiscoveries.ALL_FAILURES
+    for r in range(len(names) + 1):
+        for combo in itertools.combinations(names, r):
+            yield HasDiscoveries.all_of(combo)
+            yield HasDiscoveries.any_of(combo)
+    # Policies naming a property that does not exist.
+    yield HasDiscoveries.all_of(["no_such_prop"])
+    yield HasDiscoveries.all_of([*names, "no_such_prop"])
+    yield HasDiscoveries.any_of(["no_such_prop"])
+
+
+def _device_fires(rec, masks):
+    any_mask, all_mask, all_en = masks
+    return (rec & any_mask) != 0 or (all_en and (rec & all_mask) == all_mask)
+
+
+@pytest.mark.parametrize("props", list(_prop_sets()), ids=lambda ps: "+".join(p.name for p in ps) or "empty")
+def test_device_masks_equal_matches(props):
+    names = [p.name for p in props]
+    for policy in _policies(props):
+        masks = policy.device_masks(props)
+        for rec in range(1 << len(props)):
+            discovered = {names[i] for i in range(len(props)) if (rec >> i) & 1}
+            want = policy.matches(discovered, props)
+            got = _device_fires(rec, masks)
+            if policy._kind == "all_of" and not all(
+                n in names for n in policy._names
+            ):
+                # Documented divergence: a policy naming a missing property
+                # can never match; the device gate is disabled, and both
+                # sides must agree it never fires.
+                assert not want and not got, (policy, rec)
+            else:
+                assert want == got, (policy, rec, masks)
