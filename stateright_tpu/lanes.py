@@ -63,6 +63,96 @@ def env_fields(xp, env):
     )
 
 
+# Ordered-network rank field: per-flow FIFO position, stored in the top
+# nibble of the payload area (typ|src|dst|rank(4)|pay(16)). Handlers see
+# rank-stripped envelopes and emit rank-less sends; the ordered network
+# update assigns and maintains ranks. 4 bits suffice: a flow can hold at
+# most K <= 16 messages.
+RANK_SHIFT = 16
+RANK_FIELD = 0xF << RANK_SHIFT
+ORDERED_PAY_MASK = (1 << RANK_SHIFT) - 1
+
+
+def _flow_id(xp, env):
+    """(src, dst) flow key of an envelope word (bits 20-27)."""
+    return (env >> xp.uint32(20)) & xp.uint32(0xFF)
+
+
+def net_step_ordered(xp, net, slot_id, sends):
+    """One batched ORDERED network update over the [K*B] delivery batch.
+
+    The reference's Ordered semantics (src/actor/network.rs:62-68: per
+    directed (src, dst) flow FIFO; only heads deliverable, enforced at
+    model.rs:269-275) encoded on the same sorted K-slot ring: every
+    envelope carries its per-flow rank in the word (see RANK_SHIFT), so
+    per-flow SEQUENCES — not just multisets — determine state identity,
+    and "deliverable" is the elementwise test rank == 0.
+
+    Steps, all elementwise: remove the delivered slot (callers only
+    deliver rank-0 envelopes); decrement the rank of every other envelope
+    in the delivered flow; restore sortedness (the decrements can reorder
+    words) with an odd-even transposition pass; then insert each send
+    with rank = its flow's current depth.
+    """
+    u = xp.uint32
+    K = len(net)
+    env_all = xp.concatenate(net)
+    delivered_occ = env_all != u(0)
+    dflow = _flow_id(xp, env_all)
+    bignet = [xp.concatenate([net[m]] * K) for m in range(K)]
+    # Remove the delivered slot: entries below it shift up one.
+    cur = [
+        xp.where(
+            slot_id >= u(m),
+            bignet[m - 1] if m > 0 else u(0) * env_all,
+            bignet[m],
+        )
+        for m in range(K)
+    ]
+    # Decrement ranks within the delivered flow.
+    cur = [
+        xp.where(
+            delivered_occ & (c != u(0)) & (_flow_id(xp, c) == dflow),
+            c - u(1 << RANK_SHIFT),
+            c,
+        )
+        for c in cur
+    ]
+    # Odd-even transposition restores ascending order (zeros first: 0 is
+    # the minimum word). K passes guarantee a full sort.
+    for p in range(K):
+        start = p & 1
+        for m in range(start, K - 1, 2):
+            lo = xp.minimum(cur[m], cur[m + 1])
+            hi = xp.maximum(cur[m], cur[m + 1])
+            cur[m] = lo
+            cur[m + 1] = hi
+    # Insert sends at their flow tails (rank = current flow depth).
+    for v in sends:
+        has = v != u(0)
+        vflow = _flow_id(xp, v)
+        depth = u(0) * v
+        for m in range(K):
+            depth = depth + (
+                (cur[m] != u(0)) & (_flow_id(xp, cur[m]) == vflow)
+            ).astype(xp.uint32)
+        vr = v | (depth << u(RANK_SHIFT))
+        rank = u(0) * v
+        for m in range(1, K):
+            rank = rank + (cur[m] < vr).astype(xp.uint32)
+        nxt = []
+        for m in range(K):
+            shifted = cur[m + 1] if m + 1 < K else vr
+            placed = xp.where(
+                u(m) < rank,
+                shifted,
+                xp.where(u(m) == rank, vr, cur[m]),
+            )
+            nxt.append(xp.where(has, placed, cur[m]))
+        cur = nxt
+    return cur
+
+
 def net_step(xp, net, slot_id, sends):
     """One batched network update over the [K*B] delivery batch.
 
@@ -130,6 +220,11 @@ class ActorNetModel(TensorModel):
     """
 
     max_sends = 3
+    # Ordered mode (reference Network::Ordered, network.rs:62-68): per-flow
+    # FIFO with head-only delivery. Envelope words carry a per-flow rank
+    # nibble (see net_step_ordered); handlers still see rank-less words
+    # and payloads are limited to 16 bits instead of 20.
+    ordered = False
 
     @property
     def state_width(self) -> int:  # type: ignore[override]
@@ -150,9 +245,22 @@ class ActorNetModel(TensorModel):
     # -- shared machinery ----------------------------------------------------
 
     def pack_init_row(self, actor_values, envelopes) -> np.ndarray:
-        """One init row from per-actor lane ints + initial envelope words."""
+        """One init row from per-actor lane ints + initial envelope words.
+
+        In ordered mode, envelope list order is send order: each envelope
+        gets its per-flow FIFO rank before the canonical sort.
+        """
         row = np.zeros(self.state_width, dtype=np.uint32)
         row[: len(actor_values)] = actor_values
+        if self.ordered:
+            depth: dict = {}
+            ranked = []
+            for env in envelopes:
+                flow = (env >> 20) & 0xFF
+                r = depth.get(flow, 0)
+                depth[flow] = r + 1
+                ranked.append(env | (r << RANK_SHIFT))
+            envelopes = ranked
         env_sorted = sorted(envelopes)
         base = self.n_actor_lanes + self.K - len(env_sorted)
         for k, env in enumerate(env_sorted):
@@ -212,19 +320,33 @@ class ActorNetModel(TensorModel):
         B = lanes[0].shape[0]
 
         env_all = xp.concatenate(net)
+        if self.ordered:
+            # Handlers see rank-stripped envelopes; only flow heads
+            # (rank 0) are deliverable (model.rs:269-275).
+            deliverable = (env_all != u(0)) & (
+                (env_all & u(RANK_FIELD)) == u(0)
+            )
+            env_h = env_all & ~u(RANK_FIELD)
+        else:
+            env_h = env_all
         big = [xp.concatenate([lanes[t]] * K) for t in range(NA)]
-        new_actor, sends, changed = self.deliver(xp, big, env_all)
+        new_actor, sends, changed = self.deliver(xp, big, env_h)
         assert len(sends) <= self.max_sends
 
         slot_id = xp.concatenate(
             [xp.full(B, k, dtype=xp.uint32) for k in range(K)]
         )
-        cur = net_step(xp, net, slot_id, sends)
-
-        sent_any = env_all != env_all  # all-false, varying
-        for v in sends:
-            sent_any = sent_any | (v != u(0))
-        mask_all = (env_all != u(0)) & (changed | sent_any)
+        if self.ordered:
+            cur = net_step_ordered(xp, net, slot_id, sends)
+            # No-op deliveries are NOT pruned on the ordered network — the
+            # delivery itself mutates the flow (model.rs:345-347).
+            mask_all = deliverable
+        else:
+            cur = net_step(xp, net, slot_id, sends)
+            sent_any = env_all != env_all  # all-false, varying
+            for v in sends:
+                sent_any = sent_any | (v != u(0))
+            mask_all = (env_all != u(0)) & (changed | sent_any)
 
         succs = []
         masks = []

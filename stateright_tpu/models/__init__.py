@@ -10,11 +10,12 @@ from .fixtures import BinaryClock, DGraph, LinearEquation, Panicker
 from .two_phase_commit import TwoPhaseSys, TwoPhaseTensor
 from .increment import Increment, IncrementTensor
 from .increment_lock import IncrementLock, IncrementLockTensor
-from .abd import AbdTensor
+from .abd import AbdOrderedTensor, AbdTensor
 from .paxos import PaxosTensor
 from .single_copy import SingleCopyTensor
 
 __all__ = [
+    "AbdOrderedTensor",
     "AbdTensor",
     "BinaryClock",
     "DGraph",

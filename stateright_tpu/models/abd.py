@@ -306,3 +306,20 @@ class AbdTensor(ActorNetModel):
             "clients": clients,
             "net": decode_net(row, self.n_actor_lanes, self.K, names),
         }
+
+
+class AbdOrderedTensor(AbdTensor):
+    """ABD over the ORDERED network: per-flow FIFO, head-only delivery.
+
+    Device twin of `abd_model(c, 2, Network.new_ordered())` — the
+    reference's `linearizable-register check N ordered` workload
+    (bench.sh:33; Ordered semantics network.rs:62-68, head-of-flow rule
+    model.rs:269-275). The toolkit's ordered mode (lanes.net_step_ordered)
+    supplies the flow-rank encoding; the delivery handler is inherited
+    unchanged (ABD payloads fit the 16-bit ordered payload field).
+
+    Host-oracle goldens (exhaustive actor-model runs): 620 uniques at
+    c=2, 46,516 at c=3; linearizable HOLDS on both.
+    """
+
+    ordered = True
