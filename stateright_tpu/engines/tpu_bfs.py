@@ -106,7 +106,7 @@ def _vcap(A: int, chunk: int) -> int:
     return min(chunk * A, max(128 * A, (chunk * A) // div))
 
 
-def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
+def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False):
     """Compile the BFS device "era" loop.
 
     Returns a jitted function
@@ -122,7 +122,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
     regardless of depth — the decisive constant on this remote-attached
     platform (see the measured notes below).
     """
-    key = (id(tm), chunk, qcap, len(props))
+    key = (id(tm), chunk, qcap, len(props), canon)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -236,6 +236,11 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int):
             # entry API, bfs.rs:302-315).
             vids, vvalid, n_val = vs._compact_ids(ex.valid, vcap)
             cl = tuple(ex.flat[s][vids] for s in range(S))
+            if canon:
+                # Symmetry reduction: canonicalize at the compacted width,
+                # before fingerprinting — the ring and table then only
+                # ever see representatives.
+                cl = tm.representative_lanes(jnp, cl)
             ch1, ch2 = hash_lanes_jnp(cl)
             src = vids % u(chunk)  # parent row of candidate a*C+c is c
             cp1 = jnp.where(vvalid, row_h1[src], u(0))
@@ -487,10 +492,20 @@ class TpuBfsChecker(HostEngineBase):
         super().__init__(builder, model=model)
         if self._visitor is not None:
             raise ValueError("the TPU engine does not support visitors")
-        # Like the reference's BFS, symmetry reduction is a DFS-only feature
-        # and is ignored here (bfs.rs never reads options.symmetry).
 
         self.tm: TensorModel = model.tm
+        # Symmetry reduction ON DEVICE (beyond the reference, whose BFS
+        # ignores options.symmetry — only its DFS canonicalizes): when the
+        # builder asks for symmetry, candidates are canonicalized by the
+        # model's batched representative_lanes program before hashing and
+        # insertion, so the frontier and visited set live entirely in
+        # representative space (2pc-5: 8,832 -> 665 states).
+        self._canon = builder.symmetry_fn_ is not None
+        if self._canon and self.tm.representative_lanes is None:
+            raise ValueError(
+                f"symmetry requested but {type(self.tm).__name__} defines "
+                "no representative_lanes canonicalizer"
+            )
         self._tprops = self.tm.tensor_properties()
         n_event = sum(
             1 for p in self._tprops if p.expectation == Expectation.EVENTUALLY
@@ -527,7 +542,9 @@ class TpuBfsChecker(HostEngineBase):
         self._ckpt_every = checkpoint_every
         self._resume_from = resume_from
         self._last_ckpt = time.monotonic()
-        self._loop = _build_loop(self.tm, self._tprops, self._chunk, self._qcap)
+        self._loop = _build_loop(
+            self.tm, self._tprops, self._chunk, self._qcap, self._canon
+        )
 
         # Host-side bookkeeping.
         self._unique = 0
@@ -605,6 +622,14 @@ class TpuBfsChecker(HostEngineBase):
                 tm.within_boundary_lanes(np, init_lanes), dtype=bool
             )
             inits = inits[inb]
+            if self._canon:
+                canon_lanes = tm.representative_lanes(
+                    np, tuple(inits[:, i] for i in range(S))
+                )
+                inits = np.stack(
+                    [np.asarray(l, dtype=np.uint32) for l in canon_lanes],
+                    axis=1,
+                )
             n_init = len(inits)
             self._state_count = n_init
             if n_init == 0:
@@ -1002,4 +1027,11 @@ class TpuBfsChecker(HostEngineBase):
             cur = combine64(p1, p2)
             chain.append(cur)
         chain.reverse()
-        return Path.from_fingerprints(self._model, chain)
+        model = self._model
+        if self._canon:
+            # The table stores representative fingerprints; match raw
+            # successors by their canonical fingerprint while walking.
+            from ..tensor import CanonicalTensorAdapter
+
+            model = CanonicalTensorAdapter(self.tm)
+        return Path.from_fingerprints(model, chain)

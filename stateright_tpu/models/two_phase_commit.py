@@ -267,6 +267,55 @@ class TwoPhaseTensor(TensorModel):
 
         return succs, masks
 
+    def representative_lanes(self, xp, lanes):
+        """Batched RM-permutation canonicalization (examples/2pc.rs:203-229;
+        device analogue of TwoPhaseState.representative).
+
+        Each RM i is one descriptor word rm_state(2b) | i(4b) | prep(1b) |
+        msg(1b); an odd-even transposition network sorts the N descriptors
+        per state. The original index sits directly below the sort key, so
+        ties between equal rm_states preserve original order — exactly the
+        host's stable sort — and the carried prep/msg bits never influence
+        the order. All elementwise min/max: no gathers, no argsort.
+
+        Count semantics (measured, 2pc-5): this canonicalizer is IMPERFECT
+        (the reference's own rule — ties between equal rm_states are not
+        canonicalized over prep/msg), so the symmetry-reduced unique count
+        is traversal-defined: reference DFS = 665 (expand-original,
+        dedup-by-rep, DFS order; examples/2pc.rs:168, matched by our host
+        DFS), an expand-original BFS = 508, and the device engine's
+        canonical CLOSURE (expand representatives — the only
+        order-independent definition a batched BFS admits) = 1,092.
+        Every variant soundly covers the same equivalence classes and
+        yields identical property verdicts.
+        """
+        n = self.n
+        u = xp.uint32
+        lane0, lane1, lane2 = lanes
+        descs = []
+        for i in range(n):
+            rm = (lane1 >> u(2 * i)) & u(3)
+            prep = (lane0 >> u(2 + i)) & u(1)
+            msg = (lane2 >> u(i)) & u(1)
+            descs.append((rm << u(6)) | u(i << 2) | (prep << u(1)) | msg)
+        for p in range(n):
+            for m in range(p & 1, n - 1, 2):
+                lo = xp.minimum(descs[m], descs[m + 1])
+                hi = xp.maximum(descs[m], descs[m + 1])
+                descs[m] = lo
+                descs[m + 1] = hi
+        new0 = lane0 & u(3)  # tm_state
+        new1 = lane1 & ~u((1 << (2 * n)) - 1)
+        new2 = lane2 & ~u((1 << n) - 1)  # keep Commit/Abort bits
+        for j, d in enumerate(descs):
+            rm = (d >> u(6)) & u(3)
+            prep = (d >> u(1)) & u(1)
+            msg = d & u(1)
+            new0 = new0 | (prep << u(2 + j))
+            new1 = new1 | (rm << u(2 * j))
+            new2 = new2 | (msg << u(j))
+        return (new0, new1, new2)
+
     def tensor_properties(self) -> List[TensorProperty]:
         n = self.n
 

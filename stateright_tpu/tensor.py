@@ -95,6 +95,15 @@ class TensorModel:
         """lanes -> bool[B]; default: everything is in bounds."""
         return xp.ones(lanes[0].shape, dtype=bool)
 
+    # Symmetry reduction hook (reference Representative/RewritePlan,
+    # src/checker/{representative,rewrite_plan}.rs; SURVEY §7 step 8):
+    # lanes -> canonicalized lanes, a pure batched array program (sorting
+    # networks over entity descriptors, not argsort gathers) valid under
+    # both numpy and jax.numpy. `None` means the model has no symmetry
+    # canonicalization; engines asked for `.symmetry()` over such a model
+    # raise instead of silently ignoring the request.
+    representative_lanes = None
+
     def decode_state(self, row: np.ndarray) -> Any:
         """Human-readable view of one state row (Explorer / error messages)."""
         return tuple(int(v) for v in row)
@@ -198,6 +207,18 @@ class TensorModelAdapter(Model):
         """Shared word hash => identical fingerprints on host and device."""
         return self.tm.fingerprint_row(np.asarray(state, dtype=np.uint32))
 
+    def representative_state(self, state) -> Tuple[int, ...]:
+        """Canonical representative of a state via the model's batched
+        canonicalizer (single-row numpy evaluation). Raises if the model
+        defines no symmetry."""
+        if self.tm.representative_lanes is None:
+            raise ValueError(
+                f"{type(self.tm).__name__} defines no representative_lanes"
+            )
+        lanes = tuple(np.asarray([v], dtype=np.uint32) for v in state)
+        canon = self.tm.representative_lanes(np, lanes)
+        return tuple(int(np.asarray(l)[0]) for l in canon)
+
     # -- helpers ------------------------------------------------------------
 
     def _step_row(self, state) -> Tuple[np.ndarray, np.ndarray]:
@@ -217,3 +238,19 @@ class TensorModelAdapter(Model):
         val = (succ_rows, mask)
         self._memo_key, self._memo_val = key, val
         return val
+
+
+class CanonicalTensorAdapter(TensorModelAdapter):
+    """Adapter view whose fingerprints are of canonical REPRESENTATIVES.
+
+    Used for path reconstruction of symmetry-reduced device runs: the
+    visited table stores representative fingerprints, so the chain walker
+    must match raw successors by their canonical fingerprint. Successor
+    sets of equivalent states are equivalent, so walking raw states while
+    matching canonical fingerprints reconstructs a valid witness path.
+    """
+
+    def fingerprint_state(self, state) -> int:
+        return self.tm.fingerprint_row(
+            np.asarray(self.representative_state(state), dtype=np.uint32)
+        )
