@@ -1,0 +1,28 @@
+"""Scratch: bisect the 2pc-10 TPU worker crash trigger (round 5)."""
+import sys
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+rm = int(sys.argv[1])
+chunk = int(sys.argv[2])
+logq = int(sys.argv[3])
+logt = int(sys.argv[4])
+target = int(sys.argv[5]) if len(sys.argv) > 5 else 2_000_000
+
+tm = TwoPhaseTensor(rm)
+opts = dict(chunk_size=chunk, queue_capacity=1 << logq, table_capacity=1 << logt)
+t0 = time.perf_counter()
+try:
+    b = TensorModelAdapter(tm).checker().target_state_count(target)
+    c = b.spawn_tpu_bfs(**opts).join()
+    print(
+        f"OK rm={rm} chunk={chunk} q=2^{logq} t=2^{logt}: "
+        f"unique={c.unique_state_count()} gen={c.state_count()} "
+        f"{time.perf_counter()-t0:.1f}s",
+        flush=True,
+    )
+except Exception as e:
+    print(f"FAIL rm={rm} chunk={chunk} q=2^{logq} t=2^{logt}: {repr(e)[:140]}", flush=True)
+    sys.exit(1)

@@ -1,0 +1,171 @@
+"""Scratch: in-engine ablation of the 2pc-7 era-step body (round 5).
+
+Monkeypatches pieces of the step out (breaking semantics where needed —
+unique counts will be wrong for some configs; only wall time matters) and
+times the real engine end-to-end. Each config's loop is cache-keyed by a
+fresh model instance so ablations don't reuse stale compiled loops.
+"""
+import sys
+import time
+
+import numpy as np
+
+import stateright_tpu.engines.tpu_bfs as tb
+import stateright_tpu.ops.frontier as fr
+import stateright_tpu.ops.visited_set as vs
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+MODE = sys.argv[1]
+
+orig_dedup = fr.claim_dedup
+orig_insert = vs.insert
+
+if MODE == "full":
+    pass
+elif MODE == "no_dedup":
+    # reps = valid; insert handles in-batch dups (needs wider rcap)
+    fr.claim_dedup = lambda h1, h2, valid, cap: valid
+    tb._rcap = lambda A, chunk: (chunk * A) // 3
+elif MODE == "no_insert":
+    # table never probed: is_new = reps & (cheap pseudo-filter keeping ~11%
+    # of slots so queue growth roughly matches reality). Run bounded steps.
+    def fake_insert(table, h1, h2, p1, p2, active, rcap=None, primary_rounds=2):
+        import jax.numpy as jnp
+        u = jnp.uint32
+        is_new = active & ((h1 & u(7)) == u(0))
+        return table, is_new, active & ~active, u(0)
+    vs.insert = fake_insert
+elif MODE == "no_dedup_no_insert":
+    fr.claim_dedup = lambda h1, h2, valid, cap: valid
+    def fake_insert(table, h1, h2, p1, p2, active, rcap=None, primary_rounds=2):
+        import jax.numpy as jnp
+        u = jnp.uint32
+        is_new = active & ((h1 & u(7)) == u(0))
+        return table, is_new, active & ~active, u(0)
+    vs.insert = fake_insert
+elif MODE == "insert_no_tail":
+    def probe_all_notail(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
+        table, claim, idx, done, is_new = vs._probe_rounds(
+            table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds + 4
+        )
+        return table, claim, done, is_new
+    vs._probe_all = probe_all_notail
+elif MODE == "no_hash":
+    import stateright_tpu.fingerprint as fp_mod
+    def cheap_hash(lanes):
+        import jax.numpy as jnp
+        u = jnp.uint32
+        h1 = lanes[0] * u(0x9E3779B9)
+        h2 = lanes[0] * u(0x85EBCA6B)
+        for l in lanes[1:]:
+            h1 = h1 ^ l
+            h2 = h2 + l
+        return h1 | u(1), h2
+    fp_mod.hash_lanes_jnp = cheap_hash
+elif MODE == "no_ring_gather":
+    # pop reads replaced by a cheap slice at fixed position (breaks BFS
+    # order/uniques; timing only)
+    def fake_ring_gather(lanes, head, n):
+        import jax.numpy as jnp
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        return tuple(l[idx] for l in lanes), idx
+    fr.ring_gather = fake_ring_gather
+elif MODE == "no_ring_scatter":
+    def fake_ring_scatter(lanes, tail, cand_lanes, valid):
+        import jax.numpy as jnp
+        n = valid.shape[0]
+        return tuple(
+            l.at[jnp.uint32(0)].set(c[0]) for l, c in zip(lanes, cand_lanes)
+        )
+    fr.ring_scatter = fake_ring_scatter
+elif MODE in ("fake_expand", "fake_expand_noring"):
+    # Entire eval+expand replaced by ~15 BIG ops at C*A width (garbage
+    # semantics; bounded by target_state_count). Tests the op-count
+    # hypothesis: if the step collapses, the real expand's ~500 small
+    # [C] ops are the bottleneck.
+    import stateright_tpu.ops.expand as ex_mod
+
+    fr.claim_dedup = lambda h1, h2, valid, cap: valid
+
+    def fake_insert(table, h1, h2, p1, p2, active, rcap=None, primary_rounds=2):
+        import jax.numpy as jnp
+        u = jnp.uint32
+        is_new = active & ((h1 & u(3)) == u(0))
+        return table, is_new, active & ~active, u(0)
+    vs.insert = fake_insert
+
+    def fake_build(tm, props, chunk):
+        import jax.numpy as jnp
+        S, A, P = tm.state_width, tm.max_actions, len(props)
+        CA = chunk * A
+
+        def f(rows, row_h1, row_h2, ebits, depth, active, depth_limit):
+            u = jnp.uint32
+            iota = jnp.arange(CA, dtype=u)
+            t1 = jnp.tile(row_h1, A)
+            k = iota ^ (iota >> u(10)) ^ (iota >> u(5))
+            h1 = ((t1 ^ k) * u(0x9E3779B9)) ^ (t1 >> u(13))
+            h2 = ((t1 + k) * u(0x85EBCA6B)) | u(1)
+            valid = jnp.tile(active, A) & ((h1 & u(3)) < u(3))
+            flat = tuple(jnp.tile(rows[s], A) for s in range(S))
+            hits = [(row_h1 & u(0)) != u(0) for _ in range(P)]
+            return ex_mod.Expanded(
+                ebits=ebits,
+                flat=flat,
+                h1=h1,
+                h2=h2,
+                parent1=t1,
+                parent2=jnp.tile(row_h2, A),
+                child_ebits=jnp.tile(ebits, A),
+                child_depth=jnp.tile(depth + u(1), A),
+                valid=valid,
+                generated=valid.sum(dtype=u),
+                prop_hits=hits,
+            )
+        return f
+    ex_mod.build_eval_and_expand = fake_build
+    tb.build_eval_and_expand = fake_build
+    if MODE == "fake_expand_noring":
+        def fake_ring_gather(lanes, head, n):
+            import jax.numpy as jnp
+            idx = jnp.arange(n, dtype=jnp.uint32)
+            return tuple(l[idx] for l in lanes), idx
+        fr.ring_gather = fake_ring_gather
+        orig_scatter = fr.ring_scatter
+        def fake_ring_scatter(lanes, tail, cand_lanes, valid):
+            import jax.numpy as jnp
+            u = jnp.uint32
+            n = valid.shape[0]
+            cap = lanes[0].shape[0]
+            idx = jnp.arange(n, dtype=u) & u(cap - 1)
+            return tuple(
+                l.at[idx].set(c, mode="drop", unique_indices=True)
+                for l, c in zip(lanes, cand_lanes)
+            )
+        fr.ring_scatter = fake_ring_scatter
+else:
+    raise SystemExit(f"unknown mode {MODE}")
+
+tm = TwoPhaseTensor(7)
+opts = dict(chunk_size=6144, queue_capacity=int(sys.argv[2]) if len(sys.argv)>2 else 1 << 20, table_capacity=int(sys.argv[3]) if len(sys.argv)>3 else 1 << 22)
+
+def run():
+    b = TensorModelAdapter(tm).checker()
+    if MODE in ("no_insert", "no_dedup_no_insert", "fake_expand", "fake_expand_noring"):
+        b = b.target_state_count(2_700_000)
+    return b.spawn_tpu_bfs(**opts).join()
+
+t0 = time.perf_counter()
+c = run()
+print(f"[{MODE}] compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+for _ in range(3):
+    t0 = time.perf_counter()
+    c = run()
+    dt = time.perf_counter() - t0
+    tel = c.telemetry()
+    print(
+        f"[{MODE}] secs={dt:.3f} steps={tel['steps']} ms/step={dt/max(1,tel['steps'])*1000:.1f} "
+        f"unique={c.unique_state_count()} gen={c.state_count()}",
+        flush=True,
+    )

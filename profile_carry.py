@@ -1,0 +1,76 @@
+"""Scratch: does while/fori carry SIZE dominate per-iteration cost? (round 5)"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+
+
+def timeit(name, mk_args, fn):
+    f = jax.jit(fn, donate_argnums=tuple(range(len(mk_args()))))
+    out = f(*mk_args())
+    np.asarray(jax.tree.leaves(out)[-1])
+    args = mk_args()
+    t0 = time.perf_counter()
+    out = f(*args)
+    s = np.asarray(jax.tree.leaves(out)[-1])
+    dt = time.perf_counter() - t0
+    print(f"{name:52s} {dt/K*1000:8.2f} ms/iter  (sum={s.ravel()[:1]})", flush=True)
+
+
+def mk_while(n_lanes, lane_words, touch):
+    """while_loop carrying n_lanes x [lane_words] u32; body touches
+    element 0 of each lane (touch=True) or nothing."""
+    def run(*lanes_and_i):
+        lanes = lanes_and_i[:-1]
+        def cond(c):
+            return c[-1] < u(K)
+        def body(c):
+            ls, i = c[:-1], c[-1]
+            if touch:
+                ls = tuple(l.at[0].add(u(1)) for l in ls)
+            return ls + (i + u(1),)
+        out = lax.while_loop(cond, body, tuple(lanes) + (lanes_and_i[-1],))
+        return out
+    return run
+
+
+for n_lanes, words in [(1, 1 << 10), (4, 1 << 22), (7, 1 << 20), (11, 1 << 22)]:
+    mb = n_lanes * words * 4 / 1e6
+    mk = lambda n_lanes=n_lanes, words=words: tuple(
+        np.zeros(words, dtype=np.uint32) for _ in range(n_lanes)
+    ) + (np.uint32(0),)
+    timeit(f"while {n_lanes}x[{words}] ({mb:.0f}MB) touch0", mk, mk_while(n_lanes, words, True))
+    timeit(f"while {n_lanes}x[{words}] ({mb:.0f}MB) notouch", mk, mk_while(n_lanes, words, False))
+
+# same but fori_loop
+def mk_fori(touch):
+    def run(*lanes):
+        def body(i, ls):
+            if touch:
+                return tuple(l.at[0].add(u(1)) for l in ls)
+            return ls
+        return lax.fori_loop(0, K, body, tuple(lanes))
+    return run
+
+mk11 = lambda: tuple(np.zeros(1 << 22, dtype=np.uint32) for _ in range(11))
+timeit("fori 11x[4M] (185MB) touch0", mk11, mk_fori(True))
+
+# engine-like: big carry + a realistic scatter into one lane
+def mk_scatter_body(*lanes_and_i):
+    iota = jnp.arange(1 << 15, dtype=u)
+    def cond(c):
+        return c[-1] < u(K)
+    def body(c):
+        ls, i = c[:-1], c[-1]
+        idx = ((iota + i) * u(0x9E3779B9)) & u((1 << 22) - 1)
+        l0 = ls[0].at[idx].set(iota, mode="drop")
+        return (l0,) + ls[1:] + (i + u(1),)
+    return lax.while_loop(cond, body, lanes_and_i)
+
+mk11i = lambda: tuple(np.zeros(1 << 22, dtype=np.uint32) for _ in range(11)) + (np.uint32(0),)
+timeit("while 11x[4M] + 32k scatter into lane0", mk11i, mk_scatter_body)

@@ -1,0 +1,112 @@
+"""Scratch: isolate the big-carry while_loop penalty (round 5)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+
+
+def run_case(name, mk_fn, mk_args, K):
+    f = jax.jit(mk_fn(K), donate_argnums=tuple(range(len(mk_args()))))
+    out = f(*mk_args())
+    np.asarray(jax.tree.leaves(out)[-1])
+    args = mk_args()
+    t0 = time.perf_counter()
+    out = f(*args)
+    s = np.asarray(jax.tree.leaves(out)[-1])
+    dt = time.perf_counter() - t0
+    print(f"{name:58s} K={K:4d}  total={dt*1000:9.1f} ms  ({dt/K*1000:7.2f} ms/iter)", flush=True)
+    return dt
+
+
+# case A: carry from donated jit arguments
+def mk_A(K):
+    def run(l0, l1, l2, l3, i0):
+        def cond(c):
+            return c[-1] < u(K)
+        def body(c):
+            ls, i = c[:-1], c[-1]
+            ls = tuple(l.at[0].add(u(1)) for l in ls)
+            return ls + (i + u(1),)
+        return lax.while_loop(cond, body, (l0, l1, l2, l3, i0))
+    return run
+
+mkargs4 = lambda: tuple(np.zeros(1 << 22, dtype=np.uint32) for _ in range(4)) + (np.uint32(0),)
+for K in (1, 10, 30, 100):
+    run_case("A: while 4x[4M] from donated args, touch0", mk_A, mkargs4, K)
+
+# case B: carry created INSIDE jit (like the seeder does)
+def mk_B(K):
+    def run(i0):
+        ls = tuple(jnp.zeros(1 << 22, dtype=u) + i0 * u(0) for _ in range(4))
+        def cond(c):
+            return c[-1] < u(K)
+        def body(c):
+            ls, i = c[:-1], c[-1]
+            ls = tuple(l.at[0].add(u(1)) for l in ls)
+            return ls + (i + u(1),)
+        out = lax.while_loop(cond, body, ls + (i0,))
+        return out[-1] + out[0][0]
+    return run
+
+for K in (1, 30, 100):
+    run_case("B: while 4x[4M] created in-jit, touch0", mk_B, lambda: (np.uint32(0),), K)
+
+# case C: nested — outer fori(K) whose body runs inner fori(2) over the
+# same big carry (insert-like shape)
+def mk_C(K):
+    def run(l0, l1, l2, l3, i0):
+        def obody(i, ls):
+            def ibody(j, ls2):
+                return tuple(l.at[j].add(u(1)) for l in ls2)
+            return lax.fori_loop(0, 2, ibody, ls)
+        out = lax.fori_loop(0, K, obody, (l0, l1, l2, l3))
+        return out
+    return run
+
+for K in (30,):
+    run_case("C: fori K x inner-fori2, 4x[4M] args, touch", mk_C, mkargs4, K)
+
+# case D: 2-D carry layout
+def mk_D(K):
+    def run(l0, l1, l2, l3, i0):
+        def cond(c):
+            return c[-1] < u(K)
+        def body(c):
+            ls, i = c[:-1], c[-1]
+            ls = tuple(l.at[0, 0].add(u(1)) for l in ls)
+            return ls + (i + u(1),)
+        return lax.while_loop(cond, body, (l0, l1, l2, l3, i0))
+    return run
+
+mkargs2d = lambda: tuple(np.zeros((1 << 11, 1 << 11), dtype=np.uint32) for _ in range(4)) + (np.uint32(0),)
+run_case("D: while 4x[2048,2048] 2-D args, touch0", mk_D, mkargs2d, 30)
+
+# case E: same as A but fori instead of while
+def mk_E(K):
+    def run(l0, l1, l2, l3, i0):
+        def body(i, ls):
+            return tuple(l.at[0].add(u(1)) for l in ls)
+        return lax.fori_loop(0, K, body, (l0, l1, l2, l3))
+    return run
+
+run_case("E: fori 4x[4M] from donated args, touch0", mk_E, mkargs4, 30)
+
+# case F: while with REAL scatter work per iter (not just elem 0)
+def mk_F(K):
+    iota = jnp.arange(1 << 15, dtype=u)
+    def run(l0, l1, l2, l3, i0):
+        def cond(c):
+            return c[-1] < u(K)
+        def body(c):
+            ls, i = c[:-1], c[-1]
+            idx = ((iota + i) * u(0x9E3779B9)) & u((1 << 22) - 1)
+            ls = tuple(l.at[idx].set(iota, mode="drop") for l in ls)
+            return ls + (i + u(1),)
+        return lax.while_loop(cond, body, (l0, l1, l2, l3, i0))
+    return run
+
+run_case("F: while 4x[4M] args, 32k-scatter each lane", mk_F, mkargs4, 30)

@@ -1,0 +1,78 @@
+"""Scratch: carry penalty — device-staged args, donation on/off (round 5)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+N = 1 << 22  # 16MB per lane
+
+
+def body_factory(K):
+    def run(l0, l1, l2, l3, i0):
+        def cond(c):
+            return c[-1] < u(K)
+        def body(c):
+            ls, i = c[:-1], c[-1]
+            ls = tuple(l.at[0].add(u(1)) for l in ls)
+            return ls + (i + u(1),)
+        return lax.while_loop(cond, body, (l0, l1, l2, l3, i0))
+    return run
+
+
+def stage():
+    # Device-resident arrays produced BY a jit (so they're ordinary device
+    # buffers, like the engine's inter-era table/queue).
+    mk = jax.jit(lambda: tuple(jnp.zeros(N, dtype=u) for _ in range(4)))
+    out = mk()
+    jax.tree.map(lambda x: np.asarray(x[:1]), out)  # settle
+    return out
+
+
+for donate, label in ((True, "donated"), (False, "not-donated")):
+    f = jax.jit(body_factory(K), donate_argnums=(0, 1, 2, 3, 4) if donate else ())
+    args = stage()
+    out = f(*args, u(0))  # compile
+    np.asarray(out[-1])
+    args = stage()
+    i0 = jnp.asarray(np.uint32(0))
+    t0 = time.perf_counter()
+    out = f(*args, i0)
+    s = np.asarray(out[-1])
+    dt = time.perf_counter() - t0
+    print(f"device args, {label:12s} while 4x[4M] K={K}: total={dt*1000:8.1f} ms ({dt/K*1000:6.2f} ms/iter)", flush=True)
+
+# returning big lanes from an in-jit-created loop: is return free?
+def run_injit_ret(i0):
+    ls = tuple(jnp.zeros(N, dtype=u) + i0 * u(0) for _ in range(4))
+    def cond(c):
+        return c[-1] < u(K)
+    def body(c):
+        ls, i = c[:-1], c[-1]
+        ls = tuple(l.at[0].add(u(1)) for l in ls)
+        return ls + (i + u(1),)
+    return lax.while_loop(cond, body, ls + (i0,))
+
+f = jax.jit(run_injit_ret)
+out = f(u(0))
+np.asarray(out[-1])
+t0 = time.perf_counter()
+out = f(jnp.asarray(np.uint32(0)))
+s = np.asarray(out[-1])
+dt = time.perf_counter() - t0
+print(f"in-jit create, RETURN 4x[4M]   K={K}: total={dt*1000:8.1f} ms ({dt/K*1000:6.2f} ms/iter)", flush=True)
+
+# chain: feed returned buffers back in as donated args (era-2 simulation)
+f2 = jax.jit(body_factory(K), donate_argnums=(0, 1, 2, 3, 4))
+out2 = f2(*out[:4], out[-1])  # compile likely shared... still, run twice
+np.asarray(out2[-1])
+out = f(jnp.asarray(np.uint32(0)))
+np.asarray(out[-1])
+t0 = time.perf_counter()
+out2 = f2(*out[:4], out[-1])
+s = np.asarray(out2[-1])
+dt = time.perf_counter() - t0
+print(f"returned bufs -> donated era2   K={K}: total={dt*1000:8.1f} ms ({dt/K*1000:6.2f} ms/iter)", flush=True)

@@ -1,0 +1,25 @@
+"""Scratch: chunk-scaling experiment for the 2pc-7 device run (round 5)."""
+import sys
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 6144
+qcap = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 20
+tcap = int(sys.argv[3]) if len(sys.argv) > 3 else 1 << 22
+
+tm = TwoPhaseTensor(7)
+opts = dict(chunk_size=chunk, queue_capacity=qcap, table_capacity=tcap)
+t0 = time.perf_counter()
+c = TensorModelAdapter(tm).checker().spawn_tpu_bfs(**opts).join()  # compile
+print(f"compile+first run: {time.perf_counter()-t0:.1f}s", flush=True)
+for i in range(3):
+    t0 = time.perf_counter()
+    c = TensorModelAdapter(tm).checker().spawn_tpu_bfs(**opts).join()
+    dt = time.perf_counter() - t0
+    print(
+        f"chunk={chunk} secs={dt:.3f} gen_rate={c.state_count()/dt:,.0f} "
+        f"unique={c.unique_state_count()} tel={c.telemetry()}",
+        flush=True,
+    )

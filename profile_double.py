@@ -1,0 +1,78 @@
+"""Scratch: duplication-delta profiling of the new step pipeline (round 5).
+
+Doubling an op inside the real era loop keeps semantics identical (both
+calls are applied, the second is a no-op state-wise) while the wall-clock
+delta vs baseline reveals the op's true in-situ cost. CSE can't merge the
+pairs because the second call's inputs include the first call's output.
+"""
+import sys
+import time
+
+import numpy as np
+
+import stateright_tpu.engines.tpu_bfs as tb
+import stateright_tpu.ops.frontier as fr
+import stateright_tpu.ops.visited_set as vs
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+MODE = sys.argv[1]
+
+orig_insert = vs.insert
+orig_scatter = fr.ring_scatter
+orig_compact = vs._compact_ids
+
+if MODE == "full":
+    pass
+elif MODE == "double_insert":
+    def ins2(table, h1, h2, p1, p2, active, rcap=None, primary_rounds=vs.PRIMARY_ROUNDS):
+        table, is_new, unres, ovf = orig_insert(table, h1, h2, p1, p2, active,
+                                                rcap=rcap, primary_rounds=primary_rounds)
+        table, _n2, _u2, _o2 = orig_insert(table, h1, h2, p1, p2, active,
+                                           rcap=rcap, primary_rounds=primary_rounds)
+        return table, is_new, unres, ovf
+    vs.insert = ins2
+elif MODE == "double_scatter":
+    def sc2(lanes, tail, cand_lanes, valid):
+        lanes = orig_scatter(lanes, tail, cand_lanes, valid)
+        lanes = orig_scatter(lanes, tail, cand_lanes, valid)
+        return lanes
+    fr.ring_scatter = sc2
+elif MODE == "double_compact":
+    def cp2(mask, cap):
+        ids, valid, n = orig_compact(mask, cap)
+        # Perturb the second call's input through the first's output so CSE
+        # cannot merge them; mask2 == mask always (ids<=n... use a bit that
+        # is always false: valid has True bits; & with mask keeps mask).
+        import jax.numpy as jnp
+        m2 = mask ^ (jnp.zeros_like(mask) & (ids[0] > 0))
+        ids2, valid2, n2 = orig_compact(m2, cap)
+        return ids2, valid2, n2
+    vs._compact_ids = cp2
+elif MODE == "primary1":
+    vs.PRIMARY_ROUNDS = 1
+elif MODE == "primary3":
+    vs.PRIMARY_ROUNDS = 3
+elif MODE == "tail1x6":
+    vs.TAIL_STAGES = 1
+    vs.TAIL_ROUNDS = 6
+else:
+    raise SystemExit(f"unknown mode {MODE}")
+
+tm = TwoPhaseTensor(7)
+opts = dict(chunk_size=6144, queue_capacity=1 << 20, table_capacity=1 << 22)
+
+def run():
+    return TensorModelAdapter(tm).checker().spawn_tpu_bfs(**opts).join()
+
+c = run()
+for _ in range(3):
+    t0 = time.perf_counter()
+    c = run()
+    dt = time.perf_counter() - t0
+    tel = c.telemetry()
+    print(
+        f"[{MODE}] secs={dt:.3f} steps={tel['steps']} ms/step={dt/max(1,tel['steps'])*1000:.1f} "
+        f"unique={c.unique_state_count()}",
+        flush=True,
+    )

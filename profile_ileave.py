@@ -1,0 +1,76 @@
+"""Scratch: interleaved-flat pair access vs two separate arrays (round 5)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+CAP = 1 << 22
+W = 75776
+iota = jnp.arange(W, dtype=u)
+
+a1 = jnp.arange(CAP, dtype=u) * u(0x9E3779B9)
+a2 = jnp.arange(CAP, dtype=u) * u(0x85EBCA6B)
+il = jnp.stack([a1, a2], axis=1).reshape(-1)  # kk[2i]=a1[i], kk[2i+1]=a2[i]
+
+
+def mix(x, salt):
+    x = (x ^ u(salt)) * u(0x9E3779B9)
+    return x ^ (x >> u(16))
+
+
+def timeit(name, fn, donate=()):
+    f = jax.jit(fn, donate_argnums=donate)
+    np.asarray(f())
+    t0 = time.perf_counter()
+    s = np.asarray(f())
+    dt = time.perf_counter() - t0
+    print(f"{name:48s} {dt/K*1000:8.2f} ms/iter  sum={s}", flush=True)
+
+
+def f_sep():
+    def body(i, acc):
+        idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+        return acc ^ a1[idx].sum(dtype=u) ^ a2[idx].sum(dtype=u)
+    return lax.fori_loop(u(0), u(K), body, u(0))
+timeit("gathers: 2 separate 16MB arrays", f_sep)
+
+
+def f_il():
+    def body(i, acc):
+        idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+        return acc ^ il[2 * idx].sum(dtype=u) ^ il[2 * idx + 1].sum(dtype=u)
+    return lax.fori_loop(u(0), u(K), body, u(0))
+timeit("gathers: interleaved flat 32MB", f_il)
+
+
+def f_scat_sep():
+    def run():
+        def body(i, st):
+            b1, b2, acc = st
+            idx = mix(iota + i * u(W), 7) & u(CAP - 1)
+            b1 = b1.at[idx].set(iota, mode="drop", unique_indices=False)
+            b2 = b2.at[idx].set(iota, mode="drop", unique_indices=False)
+            return b1, b2, acc ^ b1[0] ^ b2[0]
+        out = lax.fori_loop(u(0), u(K), body,
+                            (jnp.zeros(CAP, u), jnp.zeros(CAP, u), u(0)))
+        return out[2]
+    return run()
+timeit("scatters: 2 separate 16MB arrays", f_scat_sep)
+
+
+def f_scat_il():
+    def run():
+        def body(i, st):
+            b, acc = st
+            idx = mix(iota + i * u(W), 7) & u(CAP - 1)
+            b = b.at[2 * idx].set(iota, mode="drop", unique_indices=False)
+            b = b.at[2 * idx + 1].set(iota, mode="drop", unique_indices=False)
+            return b, acc ^ b[0]
+        out = lax.fori_loop(u(0), u(K), body, (jnp.zeros(2 * CAP, u), u(0)))
+        return out[1]
+    return run()
+timeit("scatters: interleaved flat 32MB", f_scat_il)

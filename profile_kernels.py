@@ -1,0 +1,71 @@
+"""Scratch: per-kernel-launch overhead inside device loops (round 5)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+N = 1 << 10  # tiny data so data time ~ 0
+tab = (jnp.arange(1 << 20, dtype=u) * u(0x9E3779B9)) & u((1 << 20) - 1)
+
+
+def mk(n_gathers):
+    def run(x0):
+        def body(i, x):
+            g = x + i
+            for _ in range(n_gathers):
+                g = tab[g & u((1 << 20) - 1)]  # dependent gather chain
+            return g
+        return lax.fori_loop(u(0), u(K), body, x0).sum(dtype=u)
+    return run
+
+
+for n_g in (1, 4, 16, 64, 128):
+    f = jax.jit(mk(n_g))
+    x0 = jnp.arange(N, dtype=u)
+    np.asarray(f(x0))
+    t0 = time.perf_counter()
+    s = np.asarray(f(x0))
+    dt = time.perf_counter() - t0
+    per_iter = dt / K * 1000
+    per_kernel = dt / K / n_g * 1e6
+    print(f"gather-chain n={n_g:4d}: {per_iter:8.2f} ms/iter  ({per_kernel:7.1f} us/gather)", flush=True)
+
+# same chain with bigger widths: where does data cost take over?
+for W in (1 << 10, 1 << 15, 1 << 18, 1 << 20):
+    f = jax.jit(mk(16))
+    x0 = jnp.arange(W, dtype=u)
+    np.asarray(f(x0))
+    t0 = time.perf_counter()
+    s = np.asarray(f(x0))
+    dt = time.perf_counter() - t0
+    print(f"gather-chain n=16 W={W:8d}: {dt/K*1000:8.2f} ms/iter ({dt/K/16*1e6:6.1f} us/gather)", flush=True)
+
+# scatter chain
+def mk_sc(n_scatters):
+    def run(buf, x0):
+        def body(i, carry):
+            buf, x = carry
+            for k in range(n_scatters):
+                idx = (x + i * u(k + 1)) & u((1 << 20) - 1)
+                buf = buf.at[idx].set(x, mode="drop")
+                x = x + buf[0]
+            return buf, x
+        out = lax.fori_loop(u(0), u(K), body, (buf, x0))
+        return out[1].sum(dtype=u)
+    return run
+
+
+for n_s in (4, 16):
+    f = jax.jit(mk_sc(n_s), donate_argnums=(0,))
+    buf = jnp.zeros(1 << 20, dtype=u)
+    x0 = jnp.arange(N, dtype=u)
+    np.asarray(f(buf, x0))
+    buf = jnp.zeros(1 << 20, dtype=u)
+    t0 = time.perf_counter()
+    s = np.asarray(f(buf, x0))
+    dt = time.perf_counter() - t0
+    print(f"scatter+gather chain n={n_s:3d}: {dt/K*1000:8.2f} ms/iter ({dt/K/n_s/2*1e6:6.1f} us/op)", flush=True)

@@ -1,0 +1,140 @@
+"""Scratch: per-op honest microbench at 2pc-7 step shapes (round 5).
+
+Times each piece of the BFS era-step body in its own jitted counted loop,
+with a checksum carry that data-depends on the op output (block_until_ready
+lies on this platform; np.asarray of a dependent scalar is the only honest
+sync). Fresh pseudo-random inputs are derived per iteration from the loop
+counter so access patterns stay realistic.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+C = int(sys.argv[1]) if len(sys.argv) > 1 else 6144
+A = 37
+CA = C * A
+TCAP = 1 << 22
+RCAP = max(64 * A, CA // 8)
+RCAP2 = 1 << (CA // 4 - 1).bit_length()  # valid-width probe cap
+DEDUP_CAP = 1 << (2 * CA - 1).bit_length()
+K = 30
+u = jnp.uint32
+
+from stateright_tpu.ops import frontier as fr
+from stateright_tpu.ops import visited_set as vs
+from stateright_tpu.ops.expand import build_eval_and_expand
+from stateright_tpu.models import TwoPhaseTensor
+
+tm = TwoPhaseTensor(7)
+props = tm.tensor_properties()
+eval_and_expand = build_eval_and_expand(tm, props, C)
+
+
+def mix(x, salt):
+    x = (x ^ u(salt)) * u(0x9E3779B9)
+    x = (x ^ (x >> u(16))) * u(0x85EBCA6B)
+    return x ^ (x >> u(13))
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)  # compile
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = f(*args)
+    s = np.asarray(out)
+    dt = time.perf_counter() - t0
+    print(f"{name:34s} {dt/K*1000:8.3f} ms/iter   (total {dt:.3f}s, sum={s})", flush=True)
+
+
+iota_ca = jnp.arange(CA, dtype=u)
+iota_c = jnp.arange(C, dtype=u)
+
+# A ~7%-loaded table like the real run's.
+key = jax.random.PRNGKey(0)
+nfill = int(0.07 * TCAP)
+fill1 = jax.random.randint(key, (nfill,), 1, 1 << 30, dtype=jnp.int32).astype(u)
+fill2 = jax.random.randint(jax.random.PRNGKey(1), (nfill,), 1, 1 << 30, dtype=jnp.int32).astype(u)
+table0 = vs.empty_table(TCAP)
+table0, _, _, _ = vs.insert_jit(table0, fill1, fill2, fill1, fill2, jnp.ones(nfill, bool))
+table0 = tuple(np.asarray(t) for t in table0)
+
+# Realistic validity/dup profile: ~20% valid, of which ~2/3 are dups of
+# earlier steps (simulated by drawing keys from a small window).
+def cand(i, salt):
+    h1 = mix(iota_ca + i * u(CA), salt)
+    h2 = mix(iota_ca * u(3) + i, salt + 7) | u(1)
+    valid = (mix(iota_ca, salt + 13) & u(15)) < u(3)
+    return h1, h2, valid
+
+
+def loop(body):
+    def run():
+        def step(i, acc):
+            return acc ^ body(i)
+        return lax.fori_loop(u(0), u(K), step, u(0))
+    return run
+
+
+# 1. candidate generation alone (the shared preamble cost)
+timeit("preamble (mix+valid)", loop(lambda i: cand(i, 1)[0].sum(dtype=u)))
+
+# 2. claim_dedup at C*A width
+def f_dedup(i):
+    h1, h2, valid = cand(i, 2)
+    reps = fr.claim_dedup(h1, h2, valid, DEDUP_CAP)
+    return reps.sum(dtype=u)
+timeit("claim_dedup", loop(f_dedup))
+
+# 3. compact_ids at C*A -> RCAP
+def f_compact(i):
+    h1, h2, valid = cand(i, 3)
+    ids, cv, n = vs._compact_ids(valid, RCAP)
+    return ids.sum(dtype=u) + n
+timeit("compact_ids(rcap)", loop(f_compact))
+
+# 4. compacted insert (rcap) into the loaded table
+def mk_insert(rcap):
+    def f_insert(carry_tab):
+        def step(i, st):
+            tab, acc = st
+            h1, h2, valid = cand(i, 4)
+            tab, is_new, unres, novf = vs.insert(tab, h1, h2, h1, h2, valid, rcap=rcap)
+            return tab, acc ^ is_new.sum(dtype=u) + unres.sum(dtype=u)
+        tab, acc = lax.fori_loop(u(0), u(K), step, (carry_tab, u(0)))
+        return acc
+    return f_insert
+timeit(f"insert rcap={RCAP}", mk_insert(RCAP), tuple(jnp.asarray(t) for t in table0))
+timeit(f"insert rcap={RCAP2}", mk_insert(RCAP2), tuple(jnp.asarray(t) for t in table0))
+
+# 5. ring gather (7 lanes x C)
+QCAP = 1 << 20
+ring = tuple(jnp.zeros(QCAP, u) + u(w) for w in range(7))
+def f_rgather(i):
+    popped, _ = fr.ring_gather(ring, i * u(C) & u(QCAP - 1), C)
+    return sum(p.sum(dtype=u) for p in popped)
+timeit("ring_gather 7xC", loop(f_rgather))
+
+# 6. ring scatter (7 lanes x CA)
+def f_rscatter(carry_ring):
+    def step(i, st):
+        ring, acc = st
+        h1, h2, valid = cand(i, 6)
+        cl = tuple(mix(iota_ca, 20 + w) for w in range(7))
+        ring = fr.ring_scatter(ring, i * u(977), cl, valid)
+        return ring, acc ^ ring[0][0]
+    ring2, acc = lax.fori_loop(u(0), u(K), step, (carry_ring, u(0)))
+    return acc
+timeit("ring_scatter 7xCA", f_rscatter, ring)
+
+# 7. eval_and_expand (real model)
+def f_expand(i):
+    rows = tuple(mix(iota_c, 30 + s) & u(0x3FFF) for s in range(3))
+    ex = eval_and_expand(rows, mix(iota_c, 41), mix(iota_c, 42), iota_c & u(0),
+                         iota_c & u(0) + u(1), iota_c < u(C), u(0xFFFFFFFF))
+    return ex.h1.sum(dtype=u) + ex.generated
+timeit("eval_and_expand", loop(f_expand))

@@ -1,0 +1,70 @@
+"""Scratch: [cap,2] pair gather/scatter vs 2x flat u32 ops (round 5)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+CAP = 1 << 22
+W = 75776
+
+flat1 = (jnp.arange(CAP, dtype=u) * u(0x9E3779B9))
+flat2 = (jnp.arange(CAP, dtype=u) * u(0x85EBCA6B))
+pair = jnp.stack([flat1, flat2], axis=1)  # [CAP, 2]
+iota = jnp.arange(W, dtype=u)
+
+
+def mix(x, salt):
+    x = (x ^ u(salt)) * u(0x9E3779B9)
+    return x ^ (x >> u(16))
+
+
+def timeit(name, fn):
+    f = jax.jit(fn)
+    np.asarray(f())
+    t0 = time.perf_counter()
+    s = np.asarray(f())
+    dt = time.perf_counter() - t0
+    print(f"{name:46s} {dt/K*1000:8.2f} ms/iter  sum={s}", flush=True)
+
+
+def f_two_flat():
+    def body(i, acc):
+        idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+        return acc ^ flat1[idx].sum(dtype=u) ^ flat2[idx].sum(dtype=u)
+    return lax.fori_loop(u(0), u(K), body, u(0))
+timeit("2x flat u32 gather W=75776", f_two_flat)
+
+
+def f_pair():
+    def body(i, acc):
+        idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+        rows = pair[idx]  # [W, 2]
+        return acc ^ rows[:, 0].sum(dtype=u) ^ rows[:, 1].sum(dtype=u)
+    return lax.fori_loop(u(0), u(K), body, u(0))
+timeit("1x [CAP,2] pair gather W=75776", f_pair)
+
+
+def f_one_flat():
+    def body(i, acc):
+        idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+        return acc ^ flat1[idx].sum(dtype=u)
+    return lax.fori_loop(u(0), u(K), body, u(0))
+timeit("1x flat u32 gather W=75776 (floor)", f_one_flat)
+
+# u64 packed gather
+jax.config.update("jax_enable_x64", True)
+try:
+    flat64 = flat1.astype(jnp.uint64) | (flat2.astype(jnp.uint64) << 32)
+    def f_u64():
+        def body(i, acc):
+            idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+            g = flat64[idx]
+            return acc ^ (g & jnp.uint64(0xFFFFFFFF)).sum(dtype=jnp.uint64).astype(u) ^ (g >> 32).sum(dtype=jnp.uint64).astype(u)
+        return lax.fori_loop(u(0), u(K), body, u(0))
+    timeit("1x u64 gather W=75776", f_u64)
+except Exception as e:
+    print("u64 gather failed:", repr(e)[:200])

@@ -1,0 +1,125 @@
+"""Scratch: primitive-cost calibration on this TPU (round 5)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+
+
+def mix(x, salt):
+    x = (x ^ u(salt)) * u(0x9E3779B9)
+    x = (x ^ (x >> u(16))) * u(0x85EBCA6B)
+    return x ^ (x >> u(13))
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = f(*args)
+    s = np.asarray(out)
+    dt = time.perf_counter() - t0
+    print(f"{name:44s} {dt/K*1e6:9.1f} us/iter  sum={s}", flush=True)
+
+
+def loop(body):
+    def run():
+        def step(i, acc):
+            return acc ^ body(i)
+        return lax.fori_loop(u(0), u(K), step, u(0))
+    return run
+
+
+TCAP = 1 << 22
+tab = mix(jnp.arange(TCAP, dtype=u), 99)
+
+for W in (28672, 65536, 227328):
+    iota = jnp.arange(W, dtype=u)
+
+    def f_rand_gather(i, iota=iota, W=W):
+        idx = mix(iota + i * u(W), 3) & u(TCAP - 1)
+        return tab[idx].sum(dtype=u)
+    timeit(f"random gather W={W}", loop(f_rand_gather))
+
+    def f_2rand_gather(i, iota=iota, W=W):
+        idx = mix(iota + i * u(W), 3) & u(TCAP - 1)
+        return tab[idx].sum(dtype=u) + tab[idx + u(1)].sum(dtype=u)
+    timeit(f"2x random gather same idx W={W}", loop(f_2rand_gather))
+
+    def f_sorted_gather(i, iota=iota, W=W):
+        # strictly increasing indices (compaction-style coalesced access)
+        base = jnp.cumsum(mix(iota, 5) & u(15)) + i
+        idx = base & u(TCAP - 1)
+        return tab[idx].sum(dtype=u)
+    timeit(f"sorted gather W={W}", loop(f_sorted_gather))
+
+    def f_scatter(i, iota=iota, W=W):
+        idx = mix(iota + i * u(W), 7) & u(TCAP - 1)
+        out = jnp.zeros(TCAP, dtype=u).at[idx].set(iota, mode="drop")
+        return out[0] + out[TCAP - 1]
+    timeit(f"random scatter(+memset) W={W}", loop(f_scatter))
+
+    def f_elem(i, iota=iota, W=W):
+        return mix(iota + i, 11).sum(dtype=u)
+    timeit(f"elementwise mix+sum W={W}", loop(f_elem))
+
+    def f_cumsum(i, iota=iota, W=W):
+        return jnp.cumsum(mix(iota + i, 13) & u(1))[W - 1]
+    timeit(f"cumsum W={W}", loop(f_cumsum))
+
+# op-count overhead: 64 small [6144] ops that can't fuse into one (chained
+# shifts with gathers of scalar? use separate adds on distinct arrays)
+C = 6144
+lanes = [mix(jnp.arange(C, dtype=u), 20 + s) for s in range(64)]
+def f_many_ops(i):
+    acc = u(0)
+    for s in range(64):
+        acc = acc + (lanes[s] + i).sum(dtype=u)
+    return acc
+timeit("64 separate [6144] add+sum ops", loop(f_many_ops))
+
+def f_one_op(i):
+    big = jnp.concatenate(lanes)
+    return (big + i).sum(dtype=u)
+timeit("1 fused [393216] add+sum op", loop(f_one_op))
+
+# dynamic_slice pop vs gather pop at ring widths
+QCAP = 1 << 20
+ring = mix(jnp.arange(QCAP + C, dtype=u), 31)
+def f_dslice(i):
+    head = (i * u(977)) & u(QCAP - 1)
+    return lax.dynamic_slice(ring, (head,), (C,)).sum(dtype=u)
+timeit("dynamic_slice pop [6144]", loop(f_dslice))
+
+ring2 = ring[:QCAP]
+def f_gpop(i):
+    head = (i * u(977)) & u(QCAP - 1)
+    idx = (head + jnp.arange(C, dtype=u)) & u(QCAP - 1)
+    return ring2[idx].sum(dtype=u)
+timeit("gather pop [6144]", loop(f_gpop))
+
+# lax.cond with expensive branch, predicate usually false
+big_idx = mix(jnp.arange(65536, dtype=u), 41) & u(TCAP - 1)
+def f_cond(i):
+    pred = (i & u(0xFFFF)) == u(0xFFFF)  # never true for i<K
+    def expensive(_):
+        return tab[big_idx + i].sum(dtype=u)
+    def cheap(_):
+        return u(0)
+    return lax.cond(pred, expensive, cheap, None)
+timeit("cond(mostly-false) w/ 65k-gather branch", loop(f_cond))
+
+def f_cond_true(i):
+    pred = (i & u(0)) == u(0)  # always true
+    def expensive(_):
+        return tab[big_idx + i].sum(dtype=u)
+    def cheap(_):
+        return u(0)
+    return lax.cond(pred, expensive, cheap, None)
+timeit("cond(always-true) w/ 65k-gather branch", loop(f_cond_true))

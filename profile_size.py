@@ -1,0 +1,72 @@
+"""Scratch: random-gather cost vs array size (cache cliff) at W=75776."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+u = jnp.uint32
+K = 30
+W = 75776
+iota = jnp.arange(W, dtype=u)
+
+
+def mix(x, salt):
+    x = (x ^ u(salt)) * u(0x9E3779B9)
+    return x ^ (x >> u(16))
+
+
+for logcap in (18, 19, 20, 21, 22, 23):
+    CAP = 1 << logcap
+    arr = jnp.arange(CAP, dtype=u) * u(0x9E3779B9)
+
+    def f(arr=arr, CAP=CAP):
+        def body(i, acc):
+            idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+            return acc ^ arr[idx].sum(dtype=u)
+        return lax.fori_loop(u(0), u(K), body, u(0))
+
+    g = jax.jit(f)
+    np.asarray(g())
+    t0 = time.perf_counter()
+    s = np.asarray(g())
+    dt = time.perf_counter() - t0
+    print(f"gather W=75776 from {CAP*4/1e6:6.1f}MB u32: {dt/K*1000:7.2f} ms/iter", flush=True)
+
+    pair = jnp.stack([arr, arr ^ u(1)], axis=1)
+
+    def fp(pair=pair, CAP=CAP):
+        def body(i, acc):
+            idx = mix(iota + i * u(W), 3) & u(CAP - 1)
+            rows = pair[idx]
+            return acc ^ rows[:, 0].sum(dtype=u) ^ rows[:, 1].sum(dtype=u)
+        return lax.fori_loop(u(0), u(K), body, u(0))
+
+    g = jax.jit(fp)
+    np.asarray(g())
+    t0 = time.perf_counter()
+    s = np.asarray(g())
+    dt = time.perf_counter() - t0
+    print(f"pair-g W=75776 from {CAP*8/1e6:6.1f}MB [c,2]: {dt/K*1000:7.2f} ms/iter", flush=True)
+
+# scatter cost vs size
+for logcap in (19, 20, 22):
+    CAP = 1 << logcap
+
+    def fs(CAP=CAP):
+        buf0 = jnp.zeros(CAP, dtype=u)
+        def body(i, st):
+            buf, acc = st
+            idx = mix(iota + i * u(W), 7) & u(CAP - 1)
+            buf = buf.at[idx].set(iota, mode="drop")
+            return buf, acc ^ buf[0]
+        out = lax.fori_loop(u(0), u(K), body, (buf0, u(0)))
+        return out[1]
+
+    g = jax.jit(fs)
+    np.asarray(g())
+    t0 = time.perf_counter()
+    s = np.asarray(g())
+    dt = time.perf_counter() - t0
+    print(f"scatter W=75776 into {CAP*4/1e6:6.1f}MB u32: {dt/K*1000:7.2f} ms/iter", flush=True)

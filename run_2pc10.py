@@ -1,0 +1,23 @@
+"""Scratch: 2pc-10 on the device engine (round 5, VERDICT #2)."""
+import sys
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 12288
+qcap = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 23
+tcap = int(sys.argv[3]) if len(sys.argv) > 3 else 1 << 28
+
+tm = TwoPhaseTensor(10)
+opts = dict(chunk_size=chunk, queue_capacity=qcap, table_capacity=tcap)
+t0 = time.perf_counter()
+c = TensorModelAdapter(tm).checker().spawn_tpu_bfs(**opts).join()
+dt = time.perf_counter() - t0
+print(
+    f"2pc-10 device: secs={dt:.1f} unique={c.unique_state_count()} "
+    f"gen={c.state_count()} rate={c.state_count()/dt:,.0f} tel={c.telemetry()}",
+    flush=True,
+)
+assert c.unique_state_count() == 61_515_776, c.unique_state_count()
+print("GOLDEN MATCH", flush=True)

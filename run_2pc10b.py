@@ -1,0 +1,22 @@
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+tm = TwoPhaseTensor(10)
+opts = dict(
+    chunk_size=8192,
+    queue_capacity=1 << 24,
+    table_capacity=1 << 29,
+    sync_steps=128,
+)
+t0 = time.perf_counter()
+c = TensorModelAdapter(tm).checker().spawn_tpu_bfs(**opts).join()
+dt = time.perf_counter() - t0
+print(
+    f"2pc-10 device: secs={dt:.1f} unique={c.unique_state_count()} "
+    f"gen={c.state_count()} rate={c.state_count()/dt:,.0f} tel={c.telemetry()}",
+    flush=True,
+)
+assert c.unique_state_count() == 61_515_776, c.unique_state_count()
+print("GOLDEN MATCH", flush=True)

@@ -1,0 +1,20 @@
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+t0 = time.perf_counter()
+c = (
+    TensorModelAdapter(PaxosTensorExhaustive(4))
+    .checker()
+    .threads(8)
+    .timeout(1800)
+    .spawn_bfs()
+    .join()
+)
+dt = time.perf_counter() - t0
+print(
+    f"paxos-4 vbfs: secs={dt:.1f} unique={c.unique_state_count()} "
+    f"gen={c.state_count()} rate={c.state_count()/dt:,.0f} done={c.is_done()}",
+    flush=True,
+)

@@ -1,0 +1,28 @@
+import sys
+import time
+
+from examples.paxos import paxos_model
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+if __name__ == "__main__":
+    which = sys.argv[1]
+    if which == "rich2":
+        t0 = time.perf_counter()
+        c = paxos_model(2).checker().threads(8).spawn_bfs().join()
+        print(f"paxos-2 rich pbfs: unique={c.unique_state_count()} {time.perf_counter()-t0:.1f}s", flush=True)
+    elif which == "rich4":
+        t0 = time.perf_counter()
+        c = paxos_model(4).checker().threads(8).timeout(3000).spawn_bfs().join()
+        print(f"paxos-4 rich pbfs: unique={c.unique_state_count()} gen={c.state_count()} {time.perf_counter()-t0:.1f}s", flush=True)
+    elif which == "vbfs5":
+        t0 = time.perf_counter()
+        c = (
+            TensorModelAdapter(PaxosTensorExhaustive(5))
+            .checker()
+            .threads(8)
+            .timeout(3000)
+            .spawn_bfs()
+            .join()
+        )
+        print(f"paxos-5 vbfs: unique={c.unique_state_count()} gen={c.state_count()} {time.perf_counter()-t0:.1f}s", flush=True)

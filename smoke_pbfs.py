@@ -1,0 +1,10 @@
+import time
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    c = TwoPhaseSys(3).checker().threads(2).spawn_bfs().join()
+    print("2pc-3 pbfs:", c.unique_state_count(), f"{time.perf_counter()-t0:.1f}s")
+    p = c.discovery("abort agreement")
+    print("abort path:", len(p.into_states()) if p else None)

@@ -101,15 +101,29 @@ class CheckerBuilder:
     def spawn_bfs(self) -> "Checker":
         # .threads(n > 1) routes tensor-backed models to the vectorized
         # threaded engine (reference parity: multithreaded spawn_bfs,
-        # bfs.rs:90-164); rich host models raise TypeError there — state-
-        # space parallelism requires the lane encoding.
+        # bfs.rs:90-164). Rich host models get the multiprocessing
+        # ownership-sharded engine (engines/pbfs.py) — true parallelism
+        # for ANY picklable model, the job market's role re-designed for
+        # CPython (round 5; closes SURVEY component #7).
         if self.thread_count_ > 1:
-            from .engines.vbfs import VectorizedBfsChecker
+            from .tensor import TensorModel, TensorModelAdapter
 
-            return VectorizedBfsChecker(self)
+            if isinstance(self.model, (TensorModel, TensorModelAdapter)):
+                from .engines.vbfs import VectorizedBfsChecker
+
+                return VectorizedBfsChecker(self)
+            from .engines.pbfs import ParallelBfsChecker
+
+            return ParallelBfsChecker(self)
         from .engines.bfs import BfsChecker
 
         return BfsChecker(self)
+
+    def spawn_parallel_bfs(self) -> "Checker":
+        """The multiprocessing ownership-sharded BFS for rich models."""
+        from .engines.pbfs import ParallelBfsChecker
+
+        return ParallelBfsChecker(self)
 
     def spawn_vbfs(self, **kw) -> "Checker":
         """The vectorized threaded host engine over a TensorModel."""

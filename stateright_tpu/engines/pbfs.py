@@ -1,0 +1,369 @@
+"""Parallel host BFS for RICH Python models: multiprocessing ownership shards.
+
+Closes the reference's `.threads(n)`-for-any-model capability
+(job_market.rs:59-182 + bfs.rs:90-164). The reference parallelizes with
+OS threads over a shared DashMap; under CPython the GIL makes that shape
+worthless, so this engine re-designs it the same way the device mesh
+engine re-designed multi-chip checking: N worker PROCESSES, each OWNING
+the fingerprint range `fp % N == w` — its own visited dict (fp -> parent
+fp) and pending queue — exchanging candidate batches over pipes. Each
+candidate crosses process boundaries exactly once, to its owner; dedup is
+a plain dict lookup in the owner (no cross-process synchronization at
+all). This is the job market's work-distribution role with ownership
+routing in place of work stealing — the same trade the sharded device
+engine makes (parallel/mesh.py), for the same reason: cheap local dedup
+beats migrating shared state.
+
+Semantics match the reference BFS state-for-state: property evaluation at
+visit time, eventually-bit propagation along paths, the terminal rule,
+boundary filtering, depth accounting, parent-pointer path reconstruction
+(bfs.rs:196-334, 380-409). Like the reference's multithreaded BFS, visit
+order differs run to run, discovery RACES are benign (first reported
+wins), and `state_count` totals are exact.
+
+Termination is the classic double-count protocol: the coordinator polls
+(sent, received, idle) from every worker and stops when all are idle with
+equal global sent/received counts on two consecutive polls.
+
+Requirements: the model and its states must be picklable. Visitors are
+not supported (they would serialize every path across processes).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_mod
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..checker import CheckerBuilder
+from ..core import Expectation
+from ..path import Path
+from .common import HostEngineBase
+
+_BLOCK = 1500  # states per pop block, reference bfs.rs:130
+
+
+def _worker(
+    wid: int,
+    n_workers: int,
+    model_blob: bytes,
+    depth_limit: Optional[int],
+    in_q,
+    out_qs,
+    ctl_q,
+    res_q,
+):
+    """One ownership shard: own visited dict + pending queue + expansion."""
+    import cloudpickle
+
+    model = cloudpickle.loads(model_blob)
+    visited: Dict[int, int] = {}  # fp -> parent fp (0 = init)
+    pending: List[Tuple[Any, int, int, int]] = []  # (state, fp, ebits, depth)
+    discoveries: Dict[str, int] = {}  # name -> fp
+    properties = model.properties()
+    state_count = 0
+    max_depth = 0
+    sent = 0
+    received = 0
+    stop = False
+    last_report = 0.0
+
+    def accept(batch):
+        nonlocal received
+        received += len(batch)
+        for state, fp, parent_fp, ebits, depth in batch:
+            if fp in visited:
+                continue
+            visited[fp] = parent_fp
+            pending.append((state, fp, ebits, depth))
+
+    def flush_out(buckets):
+        # Local handoffs go through accept() too, so every candidate is
+        # counted once in `sent` and once in `received` globally — the
+        # invariant the quiescence protocol relies on.
+        nonlocal sent
+        for w, batch in enumerate(buckets):
+            if not batch:
+                continue
+            sent += len(batch)
+            if w == wid:
+                accept(batch)
+            else:
+                out_qs[w].put(batch)
+
+    def report(idle):
+        nonlocal last_report
+        now = time.monotonic()
+        if not idle and now - last_report < 0.05:
+            return
+        last_report = now
+        res_q.put(
+            (
+                "progress",
+                wid,
+                state_count,
+                len(visited),
+                max_depth,
+                sent,
+                received,
+                idle,
+                dict(discoveries),
+            )
+        )
+
+    while True:
+        # Drain control messages (stop / progress request).
+        try:
+            while True:
+                msg = ctl_q.get_nowait()
+                if msg == "stop":
+                    stop = True
+        except queue_mod.Empty:
+            pass
+        if stop:
+            break
+
+        # Drain incoming candidates.
+        drained = False
+        try:
+            while True:
+                batch = in_q.get_nowait()
+                accept(batch)
+                drained = True
+        except queue_mod.Empty:
+            pass
+
+        if not pending:
+            report(idle=True)
+            if not drained:
+                time.sleep(0.002)
+            continue
+
+        block = pending[-_BLOCK:]
+        del pending[-len(block):]
+        buckets: List[List] = [[] for _ in range(n_workers)]
+        for state, fp, ebits, depth in block:
+            state_count += 1
+            if depth > max_depth:
+                max_depth = depth
+            if depth_limit is not None and depth >= depth_limit:
+                continue
+
+            is_awaiting = False
+            for i, prop in enumerate(properties):
+                if prop.name in discoveries:
+                    continue
+                if prop.expectation == Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        discoveries[prop.name] = fp
+                    else:
+                        is_awaiting = True
+                elif prop.expectation == Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        discoveries[prop.name] = fp
+                    else:
+                        is_awaiting = True
+                else:  # EVENTUALLY
+                    is_awaiting = True
+                    if prop.condition(model, state):
+                        ebits &= ~(1 << i)
+
+            actions: List[Any] = []
+            model.actions(state, actions)
+            n_children = 0
+            for action in actions:
+                child = model.next_state(state, action)
+                if child is None:
+                    continue
+                n_children += 1
+                if not model.within_boundary(child):
+                    continue
+                cfp = model.fingerprint_state(child)
+                buckets[cfp % n_workers].append((child, cfp, fp, ebits, depth + 1))
+            if n_children == 0 and ebits:
+                # Terminal eventually-counterexamples (bfs.rs:326-333).
+                for i, prop in enumerate(properties):
+                    if (ebits >> i) & 1 and prop.name not in discoveries:
+                        discoveries[prop.name] = fp
+        flush_out(buckets)
+        report(idle=False)
+
+    # Final: one last exact progress report, then the visited table for
+    # path reconstruction.
+    last_report = 0.0
+    report(idle=True)
+    res_q.put(("table", wid, visited))
+
+
+class ParallelBfsChecker(HostEngineBase):
+    """Multiprocessing ownership-sharded BFS over any picklable Model."""
+
+    _supports_threads = True
+
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        if self._visitor is not None:
+            raise ValueError(
+                "the parallel host engine does not support visitors"
+            )
+        # Reference parity: BFS ignores options.symmetry (bfs.rs never
+        # reads it); DFS is the symmetry engine.
+        self._n = max(2, self._thread_count)
+        self._discovery_fps: Dict[str, int] = {}
+        self._unique = 0
+        self._tables: List[Dict[int, int]] = []
+        self._start()
+
+    def _run(self) -> None:
+        import cloudpickle
+
+        model = self._model
+        # cloudpickle (not plain pickle) ships the model: actor models are
+        # typically assembled from closures/lambdas, which pickle rejects.
+        model_blob = cloudpickle.dumps(model)
+        n = self._n
+        # spawn, not fork: the parent typically holds a live JAX runtime
+        # (device tunnels, threads) that must not be duplicated into the
+        # workers; workers import only the model's own modules.
+        ctx = mp.get_context("spawn")
+        in_qs = [ctx.Queue() for _ in range(n)]
+        ctl_qs = [ctx.Queue() for _ in range(n)]
+        res_q = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_worker,
+                args=(
+                    w,
+                    n,
+                    model_blob,
+                    self._target_max_depth,
+                    in_qs[w],
+                    in_qs,
+                    ctl_qs[w],
+                    res_q,
+                ),
+                daemon=True,
+            )
+            for w in range(n)
+        ]
+        for p in procs:
+            p.start()
+
+        # Seed: route init states to their owners.
+        seeds: List[List] = [[] for _ in range(n)]
+        for state in model.init_states():
+            if not model.within_boundary(state):
+                continue
+            fp = model.fingerprint_state(state)
+            seeds[fp % n].append((state, fp, 0, self._init_ebits, 1))
+        n_seeded = sum(len(s) for s in seeds)
+        for w in range(n):
+            if seeds[w]:
+                in_qs[w].put(seeds[w])
+
+        stats = {
+            w: dict(sc=0, uniq=0, maxd=0, sent=0, recv=0, idle=False, disc={})
+            for w in range(n)
+        }
+        quiet_polls = 0
+        try:
+            while True:
+                try:
+                    msg = res_q.get(timeout=0.05)
+                except queue_mod.Empty:
+                    msg = None
+                if msg is not None and msg[0] == "progress":
+                    _, wid, sc, uniq, maxd, sent, recv, idle, disc = msg
+                    stats[wid] = dict(
+                        sc=sc, uniq=uniq, maxd=maxd, sent=sent, recv=recv,
+                        idle=idle, disc=disc,
+                    )
+                    for name, fp in disc.items():
+                        self._discovery_fps.setdefault(name, fp)
+                self._state_count = sum(s["sc"] for s in stats.values())
+                self._unique = sum(s["uniq"] for s in stats.values())
+                self._max_depth = max(
+                    [s["maxd"] for s in stats.values()] + [self._max_depth]
+                )
+
+                if self._finish_matched(self._discovery_fps):
+                    break
+                if (
+                    self._target_state_count is not None
+                    and self._state_count >= self._target_state_count
+                ):
+                    break
+                if self._timed_out():
+                    break
+                # Double-count quiescence: all idle AND global sent ==
+                # global received (+ seeds) on two consecutive polls.
+                all_idle = all(s["idle"] for s in stats.values())
+                g_sent = sum(s["sent"] for s in stats.values()) + n_seeded
+                g_recv = sum(s["recv"] for s in stats.values())
+                if all_idle and g_sent == g_recv:
+                    quiet_polls += 1
+                    if quiet_polls >= 2:
+                        break
+                else:
+                    quiet_polls = 0
+        finally:
+            for w in range(n):
+                ctl_qs[w].put("stop")
+            tables: Dict[int, Dict[int, int]] = {}
+            deadline = time.monotonic() + 30
+            while len(tables) < n and time.monotonic() < deadline:
+                try:
+                    msg = res_q.get(timeout=1.0)
+                except queue_mod.Empty:
+                    continue
+                if msg[0] == "table":
+                    tables[msg[1]] = msg[2]
+                elif msg[0] == "progress":
+                    _, wid, sc, uniq, maxd, sent, recv, idle, disc = msg
+                    stats[wid] = dict(
+                        sc=sc, uniq=uniq, maxd=maxd, sent=sent, recv=recv,
+                        idle=idle, disc=disc,
+                    )
+                    for name, fp in disc.items():
+                        self._discovery_fps.setdefault(name, fp)
+            self._tables = [tables.get(w, {}) for w in range(n)]
+            self._state_count = sum(s["sc"] for s in stats.values())
+            self._unique = sum(s["uniq"] for s in stats.values())
+            self._max_depth = max(
+                [s["maxd"] for s in stats.values()] + [self._max_depth]
+            )
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+    # -- accessors ----------------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return self._unique
+
+    def discoveries(self) -> Dict[str, Path]:
+        self.join()
+        return {
+            name: self._reconstruct(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }
+
+    def _reconstruct(self, fp: int) -> Path:
+        """Walk parent pointers across the shard tables (owner = fp % N)."""
+        chain = [fp]
+        cur = fp
+        for _ in range(10_000_000):
+            parent = self._tables[cur % self._n].get(cur)
+            if parent is None:
+                raise RuntimeError(
+                    f"fingerprint {cur} missing from shard table during "
+                    "path reconstruction"
+                )
+            if parent == 0:
+                break
+            cur = parent
+            chain.append(cur)
+        chain.reverse()
+        return Path.from_fingerprints(self._model, chain)

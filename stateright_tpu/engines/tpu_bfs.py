@@ -408,6 +408,39 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
 
 
 _SEED_CACHE: Dict[Tuple, Any] = {}
+_SEED_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
+
+
+def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
+                     canon: bool):
+    """Fuse run seeding and the FIRST era into one jitted dispatch.
+
+    On this platform every dispatch costs a ~100ms tunnel round-trip, and
+    time-to-first-counterexample is a primary metric (BASELINE.md): a bug
+    a few steps deep should cost ONE round-trip, not a seed trip plus an
+    era trip. The composed program inlines the jitted seeder and era loop;
+    a run whose discovery fires in era 1 (or that completes outright)
+    never pays a second dispatch.
+    """
+    key = (id(tm), chunk, qcap, tcap, len(props), canon)
+    cached = _SEED_LOOP_CACHE.get(key)
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+    while len(_SEED_LOOP_CACHE) >= 16:
+        _SEED_LOOP_CACHE.pop(next(iter(_SEED_LOOP_CACHE)))
+
+    import jax
+
+    loop = _build_loop(tm, props, chunk, qcap, canon)
+    seed = _build_seed(tm.state_width, qcap, tcap)
+
+    @jax.jit
+    def seed_run(qinit, h1, h2, params, rec_fp1, rec_fp2):
+        table, queue, params2 = seed(qinit, h1, h2, params)
+        return loop(table, queue, rec_fp1, rec_fp2, params2)
+
+    _SEED_LOOP_CACHE[key] = (tm, seed_run)
+    return seed_run
 
 
 def _build_seed(S: int, qcap: int, tcap: int):
@@ -615,6 +648,7 @@ class TpuBfsChecker(HostEngineBase):
             table, queue, head, count, rec_bits, rec_fp1, rec_fp2 = (
                 self._load_checkpoint(self._resume_from, W)
             )
+            first_result_pending = False
         else:
             inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
             init_lanes = tuple(inits[:, i] for i in range(S))
@@ -669,31 +703,129 @@ class TpuBfsChecker(HostEngineBase):
                 0, int(vs.MAX_LOAD * self._tcap) - vcap
             )
 
-            _dbg("run: dispatching seeder")
-            seed = _build_seed(S, self._qcap, self._tcap)
-            table, queue, params_dev = seed(
+            rec_bits = 0
+            rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
+            rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
+            _dbg("run: dispatching fused seed+first-era")
+            seed_run = _build_seed_loop(
+                tm, self._tprops, C, self._qcap, self._tcap, self._canon
+            )
+            table, queue, rec_fp1, rec_fp2, params_dev = seed_run(
                 jnp.asarray(qinit), jnp.asarray(h1), jnp.asarray(h2),
-                jnp.asarray(template),
+                jnp.asarray(template), rec_fp1, rec_fp2,
             )
             head = 0
             count = n_init
             # Provisional (exact unless dup inits); corrected at first read.
             self._unique = n_init
             last_max_steps = max_steps0
+            first_result_pending = True
             _dbg("run: seeded; entering era loop")
-
-        if self._resume_from is None:
-            rec_bits = 0
-            rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
-            rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
 
         # Spill hysteresis: drain down to / refill up to this margin below
         # high_water, so a spilling run still gets long eras between host
         # round-trips instead of bouncing on the watermark (see the drain
         # note below). Guaranteed >= one block of room: qcap >= 2*C*A.
         spill_target = max(high_water // 2, high_water - 64 * C * A)
+        stop = False
 
-        while count > 0 or self._spill:
+        def process_result():
+            """Consume one era result (the fused seed+first-era dispatch or
+            a loop dispatch): counters, discoveries, spill, checkpoints,
+            and stop conditions."""
+            nonlocal head, count, take_cap, rec_bits, stop, params_dev
+            vals = np.asarray(params_dev)  # the ONE download per block
+            _dbg(
+                f"era result steps={vals[10]} gen={vals[8]} count={vals[1]} "
+                f"unique={vals[2]} rec={vals[3]:b}"
+            )
+            if int(vals[11]):
+                # Cannot happen with the proactive growth short of a
+                # pathological probe sequence; losing states would be an
+                # unsound "verified", so fail loudly. A nonzero error with
+                # ZERO steps on the first era means the unresolved count
+                # flowed in from the seeder (init-state insert), not the
+                # era loop — attribute it correctly.
+                if self._telemetry["eras"] == 0 and int(vals[10]) == 0:
+                    raise RuntimeError(
+                        "init-state seeding exhausted the visited-table "
+                        "probe budget (duplicate-heavy or adversarial "
+                        "initial fingerprints); raise table_capacity"
+                    )
+                raise RuntimeError(
+                    "visited-table probe budget exhausted despite headroom"
+                )
+            head = int(vals[0])
+            count = int(vals[1])
+            take_cap = int(vals[P_TAKE_CAP])
+            self._telemetry["eras"] += 1
+            self._telemetry["steps"] += int(vals[10])
+            self._telemetry["take_cap"] = take_cap
+            self._unique = int(vals[2])
+            self._state_count += int(vals[8])
+            self._max_depth = max(self._max_depth, int(vals[9]))
+            # Record first discovery per property (reference races are
+            # benign; ours are deterministic per compiled program).
+            new_bits = int(vals[3])
+            if new_bits != rec_bits:
+                fp1 = np.asarray(rec_fp1)
+                fp2 = np.asarray(rec_fp2)
+                for i, p in enumerate(self._tprops):
+                    if (new_bits >> i) & 1 and p.name not in self._discovery_fps:
+                        self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
+                rec_bits = new_bits
+
+            # Spill if the next chunk could overflow the ring. Drain to the
+            # MARGIN below the watermark, not just to it: draining only the
+            # overhang lets the very next era re-cross the line after a few
+            # steps, thrashing spill round-trips (measured on ABD c=4:
+            # 2-3 useful steps per ~7s spill cycle). The margin trades one
+            # bigger drain for eras long enough to amortize it.
+            if count > high_water:
+                k = count - spill_target
+                take_idx = jnp.asarray(
+                    (head + count - k + np.arange(k)) & (self._qcap - 1)
+                )
+                # Stack on device, download ONCE (per-lane downloads cost a
+                # ~100ms round-trip each on this platform).
+                big = np.asarray(
+                    jnp.stack([queue[i][take_idx] for i in range(W)], axis=1)
+                )
+                # Keep blocks refill-sized so partial refills stay possible.
+                for off in range(0, k, C * A):
+                    self._spill.append(big[off : off + C * A])
+                count -= k
+                self._telemetry["spill_rows"] += k
+                # Refills can place these rows after deeper children, breaking
+                # the ring's depth monotonicity that the block-level maxd read
+                # relies on — fold their depth in here. (Counts rows that are
+                # guaranteed to be visited unless the run stops early; a rare
+                # slight over-report beats a systematic under-report.)
+                self._max_depth = max(self._max_depth, int(big[:, S + 1].max()))
+                params_dev = None  # host-side count changed; force re-upload
+
+            if self._ckpt_path is not None and (
+                self._ckpt_every is not None
+                and time.monotonic() - self._last_ckpt >= self._ckpt_every
+            ):
+                self._save_checkpoint(
+                    table, queue, head, count, rec_bits, rec_fp1, rec_fp2
+                )
+
+            if self._finish_matched(self._discovery_fps):
+                stop = True
+            elif (
+                self._target_state_count is not None
+                and self._state_count >= self._target_state_count
+            ):
+                stop = True
+            elif self._timed_out():
+                stop = True
+
+        if first_result_pending:
+            process_result()
+
+        while not stop and (count > 0 or self._spill):
             host_dirty = params_dev is None
             # Refill from host spill, leaving room for the worst-case append
             # (count must stay <= high_water going into the loop, or the
@@ -778,97 +910,11 @@ class TpuBfsChecker(HostEngineBase):
             table, queue, rec_fp1, rec_fp2, params_dev = self._loop(
                 table, queue, rec_fp1, rec_fp2, params_in
             )
-            _t1 = time.monotonic()
-            vals = np.asarray(params_dev)  # the ONE download per block
             _dbg(
                 f"block dirty={host_dirty} max_steps={max_steps} "
-                f"dispatch={_t1 - _t0:.3f}s read={time.monotonic() - _t1:.3f}s "
-                f"steps={vals[10]} gen={vals[8]} count={vals[1]} "
-                f"unique={vals[2]} rec={vals[3]:b}"
+                f"dispatch={time.monotonic() - _t0:.3f}s"
             )
-
-            if int(vals[11]):
-                # Cannot happen with the proactive growth above short of a
-                # pathological probe sequence; losing states would be an
-                # unsound "verified", so fail loudly. A nonzero error with
-                # ZERO steps on the first era means the unresolved count
-                # flowed in from the seeder (init-state insert), not the
-                # era loop — attribute it correctly.
-                if self._telemetry["eras"] == 0 and int(vals[10]) == 0:
-                    raise RuntimeError(
-                        "init-state seeding exhausted the visited-table "
-                        "probe budget (duplicate-heavy or adversarial "
-                        "initial fingerprints); raise table_capacity"
-                    )
-                raise RuntimeError(
-                    "visited-table probe budget exhausted despite headroom"
-                )
-            head = int(vals[0])
-            count = int(vals[1])
-            take_cap = int(vals[P_TAKE_CAP])
-            self._telemetry["eras"] += 1
-            self._telemetry["steps"] += int(vals[10])
-            self._telemetry["take_cap"] = take_cap
-            self._unique = int(vals[2])
-            self._state_count += int(vals[8])
-            self._max_depth = max(self._max_depth, int(vals[9]))
-            # Record first discovery per property (reference races are
-            # benign; ours are deterministic per compiled program).
-            new_bits = int(vals[3])
-            if new_bits != rec_bits:
-                fp1 = np.asarray(rec_fp1)
-                fp2 = np.asarray(rec_fp2)
-                for i, p in enumerate(self._tprops):
-                    if (new_bits >> i) & 1 and p.name not in self._discovery_fps:
-                        self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
-                rec_bits = new_bits
-
-            # Spill if the next chunk could overflow the ring. Drain to the
-            # MARGIN below the watermark, not just to it: draining only the
-            # overhang lets the very next era re-cross the line after a few
-            # steps, thrashing spill round-trips (measured on ABD c=4:
-            # 2-3 useful steps per ~7s spill cycle). The margin trades one
-            # bigger drain for eras long enough to amortize it.
-            if count > high_water:
-                k = count - spill_target
-                take_idx = jnp.asarray(
-                    (head + count - k + np.arange(k)) & (self._qcap - 1)
-                )
-                # Stack on device, download ONCE (per-lane downloads cost a
-                # ~100ms round-trip each on this platform).
-                big = np.asarray(
-                    jnp.stack([queue[i][take_idx] for i in range(W)], axis=1)
-                )
-                # Keep blocks refill-sized so partial refills stay possible.
-                for off in range(0, k, C * A):
-                    self._spill.append(big[off : off + C * A])
-                count -= k
-                self._telemetry["spill_rows"] += k
-                # Refills can place these rows after deeper children, breaking
-                # the ring's depth monotonicity that the block-level maxd read
-                # relies on — fold their depth in here. (Counts rows that are
-                # guaranteed to be visited unless the run stops early; a rare
-                # slight over-report beats a systematic under-report.)
-                self._max_depth = max(self._max_depth, int(big[:, S + 1].max()))
-                params_dev = None  # host-side count changed; force re-upload
-
-            if self._ckpt_path is not None and (
-                self._ckpt_every is not None
-                and time.monotonic() - self._last_ckpt >= self._ckpt_every
-            ):
-                self._save_checkpoint(
-                    table, queue, head, count, rec_bits, rec_fp1, rec_fp2
-                )
-
-            if self._finish_matched(self._discovery_fps):
-                break
-            if (
-                self._target_state_count is not None
-                and self._state_count >= self._target_state_count
-            ):
-                break
-            if self._timed_out():
-                break
+            process_result()
 
         # A final checkpoint makes interrupted runs (targets, timeouts)
         # resumable from their exact stopping point.

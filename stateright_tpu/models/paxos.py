@@ -381,10 +381,28 @@ class PaxosTensor(ActorNetModel):
                 acc = acc | (is_gok & (val != u(1)))
             return acc
 
+        def ballot_rounds_in_range(xp, lanes):
+            # The 3-bit term-round packing caps rounds at 7; a server
+            # incrementing past that would silently wrap and MERGE
+            # distinct states. Like the net-capacity guard, this turns an
+            # encoding-bound violation into a loud counterexample instead
+            # of a silently wrong unique count (relevant from c=4 up,
+            # where deeper election races could push rounds higher).
+            u = xp.uint32
+            acc = lanes[0] == lanes[0]  # all-true, varying
+            for j in range(3):
+                a = lanes[2 * j]
+                acc = acc & (((a & u(31)) >> u(2)) < u(7))
+                acc = acc & ((((a >> u(12)) & u(31)) >> u(2)) < u(7))
+            return acc
+
         return [
             TensorProperty.always("linearizable", self.linearizable_lanes),
             TensorProperty.sometimes("value chosen", value_chosen),
             self.net_capacity_property(),
+            TensorProperty.always(
+                "ballot rounds within range", ballot_rounds_in_range
+            ),
         ]
 
     # -- display ------------------------------------------------------------
