@@ -1,0 +1,50 @@
+"""Parallel host BFS for rich models (engines/pbfs.py).
+
+The multiprocessing ownership-sharded engine must agree with the
+single-threaded host engine on unique counts, verdicts, and produce
+valid reconstructable discovery paths — for plain Models AND for actor
+models assembled from closures (shipped via cloudpickle).
+"""
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def test_2pc3_golden_and_paths():
+    c = TwoPhaseSys(3).checker().threads(2).spawn_bfs().join()
+    assert c.unique_state_count() == 288  # examples/2pc.rs:154
+    assert c.discovery("consistent") is None
+    for name in ("abort agreement", "commit agreement"):
+        p = c.discovery(name)
+        assert p is not None
+        # Path.from_fingerprints re-executes the model: a non-None path
+        # proves the cross-shard parent chain reconstructed validly.
+        assert len(p.into_states()) >= 2
+
+
+def test_2pc5_golden():
+    c = TwoPhaseSys(5).checker().threads(4).spawn_bfs().join()
+    assert c.unique_state_count() == 8832  # examples/2pc.rs:159
+    assert c.discovery("consistent") is None
+
+
+def test_closure_built_actor_model():
+    # Actor models are assembled from lambdas/closures; plain pickle
+    # rejects them — cloudpickle shipping must handle it.
+    from examples.linearizable_register import abd_model
+
+    c = abd_model(2, 2).checker().threads(2).spawn_bfs().join()
+    assert c.unique_state_count() == 544  # linearizable-register.rs:287
+    assert c.discovery("linearizable") is None
+
+
+def test_target_state_count_stops_early():
+    c = (
+        TwoPhaseSys(5)
+        .checker()
+        .threads(2)
+        .target_state_count(500)
+        .spawn_bfs()
+        .join()
+    )
+    assert c.state_count() >= 500
+    assert c.unique_state_count() < 8832
