@@ -1,0 +1,24 @@
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    c = (
+        TensorModelAdapter(PaxosTensorExhaustive(6))
+        .checker()
+        .threads(8)
+        .timeout(3600)
+        .spawn_bfs()
+        .join()
+    )
+    dt = time.perf_counter() - t0
+    print(
+        f"paxos-6 vbfs: unique={c.unique_state_count()} gen={c.state_count()} "
+        f"{dt:.1f}s done_exhaustive={not c._timed_out()}",
+        flush=True,
+    )
+    for name in ("network within capacity", "ballot rounds within range", "linearizable"):
+        d = c.discovery(name)
+        print(f"  guard {name!r}: {'VIOLATED' if d is not None else 'quiet'}", flush=True)

@@ -1,0 +1,25 @@
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+if __name__ == "__main__":
+    tm = PaxosTensorExhaustive(6)
+    opts = dict(
+        chunk_size=8192,
+        queue_capacity=1 << 21,
+        table_capacity=1 << 26,
+        sync_steps=128,
+    )
+    t0 = time.perf_counter()
+    c = TensorModelAdapter(tm).checker().spawn_tpu_bfs(**opts).join()
+    dt = time.perf_counter() - t0
+    print(
+        f"paxos-6 device: secs={dt:.1f} unique={c.unique_state_count()} "
+        f"gen={c.state_count()} rate={c.state_count()/dt:,.0f} tel={c.telemetry()}",
+        flush=True,
+    )
+    assert c.unique_state_count() == 9_357_525, c.unique_state_count()
+    for name in ("network within capacity", "ballot rounds within range", "linearizable"):
+        assert c.discovery(name) is None, name
+    print("GOLDEN MATCH + guards quiet", flush=True)

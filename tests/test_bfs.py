@@ -74,13 +74,14 @@ def test_target_max_depth_limits_depth():
     # Depth-3 jobs are popped but skipped, so generated states reach depth 3:
     # (0,0) + {(1,0),(0,1)} + {(2,0),(1,1),(0,2)} = 6 unique states.
     assert checker.unique_state_count() == 6
-def test_threads_gt1_raises_on_host_engines():
+def test_threads_gt1_routes_or_raises_per_engine():
     from stateright_tpu.models.fixtures import BinaryClock
 
-    # threads>1 spawn_bfs routes to the vectorized engine, which requires
-    # the lane encoding — rich host models are rejected with TypeError.
-    with pytest.raises(TypeError, match="TensorModel"):
-        BinaryClock().checker().threads(4).spawn_bfs()
+    # threads>1 spawn_bfs routes rich models to the multiprocessing
+    # ownership-sharded engine (round 5); DFS stays single-threaded and
+    # raises loudly rather than silently ignoring the setting.
+    c = BinaryClock().checker().threads(2).spawn_bfs().join()
+    assert c.unique_state_count() == 2
     with pytest.raises(NotImplementedError, match="single-threaded"):
         BinaryClock().checker().threads(2).spawn_dfs()
 

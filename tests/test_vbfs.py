@@ -103,11 +103,13 @@ def test_visited_set_growth():
     assert not new2.any()
 
 
-def test_rich_host_models_rejected():
+def test_rich_host_models_route_to_parallel_engine():
+    from stateright_tpu.engines.pbfs import ParallelBfsChecker
     from stateright_tpu.models.fixtures import BinaryClock
 
-    with pytest.raises((TypeError, NotImplementedError)):
-        BinaryClock().checker().threads(4).spawn_bfs()
+    c = BinaryClock().checker().threads(4).spawn_bfs()
+    assert isinstance(c, ParallelBfsChecker)
+    assert c.join().unique_state_count() == 2
 
 
 def test_tpc7_exact_row_golden():
