@@ -38,6 +38,9 @@ import time
 
 PAXOS2_GOLDEN = 16_668  # examples/paxos.rs:327
 PAXOS3_GOLDEN = 1_194_428  # host-oracle run of PaxosTensorExhaustive(3)
+PAXOS6_GOLDEN = 9_357_525  # threaded-host exhaustive run (round 5; the
+# paxos space grows ~x2/client past c=3: 2.37M @ c4, 4.71M @ c5, 9.36M @ c6,
+# with the capacity + ballot-round encoding guards quiet throughout)
 TPC7_GOLDEN = 296_448  # EXACT-row oracle count of TwoPhaseTensor(7)
 TPC10_GOLDEN = 61_515_776  # threaded-host exhaustive run (round 4)
 ABD3_ORDERED_GOLDEN = 46_516  # host actor-model exhaustive run (round 5)
@@ -247,10 +250,13 @@ def main() -> None:
     }
 
     # --- TTFC: increment race (BFS, fused seed+first-era) ------------------
+    # One dispatch + one readback end to end: seeding, the era loop, AND
+    # the discovery fingerprints all ride a single device round-trip.
     inc = IncrementTensor(2)
-    TensorModelAdapter(inc).checker().spawn_tpu_bfs().join()  # compile
+    incopts = dict(chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 10)
+    TensorModelAdapter(inc).checker().spawn_tpu_bfs(**incopts).join()  # compile
     medt, _spreadt, _devi = timed3(
-        lambda: TensorModelAdapter(inc).checker().spawn_tpu_bfs(),
+        lambda: TensorModelAdapter(inc).checker().spawn_tpu_bfs(**incopts),
         check=lambda c: c.discovery("fin") is not None,
     )
     detail["ttfc_increment_race_secs"] = round(medt, 3)
@@ -311,6 +317,37 @@ def main() -> None:
         "unique": d3.unique_state_count(),
         "secs": round(secs3, 3),
         "golden_match": True,
+    }
+    emit(dev_rate, vs_threaded, partial=True)
+
+    # --- paxos check 6: bench.sh:31 parity — ON DEVICE (round 5) ----------
+    # The full reference bench workload, checked exhaustively: 9,357,525
+    # uniques, golden-matched against the threaded host's 17-minute run
+    # (the device does it in ~8). Encoding guards (network capacity,
+    # ballot-round range) asserted quiet.
+    px6 = PaxosTensorExhaustive(6)
+    t0 = time.perf_counter()
+    d6 = (
+        TensorModelAdapter(px6)
+        .checker()
+        .spawn_tpu_bfs(
+            chunk_size=8192,
+            queue_capacity=1 << 21,
+            table_capacity=1 << 26,
+            sync_steps=128,
+        )
+        .join()
+    )
+    secs6 = time.perf_counter() - t0
+    assert d6.unique_state_count() == PAXOS6_GOLDEN, d6.unique_state_count()
+    assert d6.discovery("network within capacity") is None
+    assert d6.discovery("ballot rounds within range") is None
+    detail["paxos6"] = {
+        "states_per_sec": round(d6.state_count() / secs6, 1),
+        "unique": d6.unique_state_count(),
+        "secs": round(secs6, 1),
+        "golden_match": True,
+        "host_threaded_secs": 1037.3,
     }
     emit(dev_rate, vs_threaded, partial=True)
 

@@ -88,6 +88,12 @@ P_FIN_ANY = 13  # era exits when (rec & fin_any) != 0
 P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
 P_FIN_ALL_EN = 15
 P_LEN = 16
+# The packed vector is P_LEN + 2*P words long: the tail carries the
+# recorded discovery fingerprint halves (rec_fp1 | rec_fp2), so the era
+# result download returns counters AND discovery fingerprints in ONE
+# round-trip (a separate rec_fp read costs ~100ms on this platform —
+# directly on the time-to-first-counterexample path). The loop reads only
+# [0:P_LEN] of its input; the tail is write-only output.
 
 
 def _vcap(A: int, chunk: int) -> int:
@@ -381,24 +387,30 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         maxd = jnp.where(
             steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
-        params_out = jnp.stack(
+        params_out = jnp.concatenate(
             [
-                head,
-                count,
-                unique,
-                rec_bits_out,
-                depth_limit,
-                grow_limit,
-                high_water,
-                max_steps,
-                gen,
-                maxd,
-                steps,
-                (err_cnt > 0).astype(u),
-                take_cap_out,
-                fin_any,
-                fin_all,
-                fin_all_en,
+                jnp.stack(
+                    [
+                        head,
+                        count,
+                        unique,
+                        rec_bits_out,
+                        depth_limit,
+                        grow_limit,
+                        high_water,
+                        max_steps,
+                        gen,
+                        maxd,
+                        steps,
+                        (err_cnt > 0).astype(u),
+                        take_cap_out,
+                        fin_any,
+                        fin_all,
+                        fin_all_en,
+                    ]
+                ),
+                rec_fp1,
+                rec_fp2,
             ]
         )
         return table, queue, rec_fp1, rec_fp2, params_out
@@ -691,7 +703,7 @@ class TpuBfsChecker(HostEngineBase):
                 max_steps0 = max(
                     1, min(max_steps0, 1 + remaining // max(1, C * A))
                 )
-            template = np.zeros(P_LEN, dtype=np.uint32)
+            template = np.zeros(P_LEN + 2 * P, dtype=np.uint32)
             template[P_DEPTH_LIMIT] = depth_limit
             template[P_HIGH_WATER] = high_water
             template[P_MAX_STEPS] = max_steps0
@@ -768,8 +780,10 @@ class TpuBfsChecker(HostEngineBase):
             # benign; ours are deterministic per compiled program).
             new_bits = int(vals[3])
             if new_bits != rec_bits:
-                fp1 = np.asarray(rec_fp1)
-                fp2 = np.asarray(rec_fp2)
+                # Discovery fingerprints ride the params tail — no extra
+                # device read on the counterexample path.
+                fp1 = vals[P_LEN : P_LEN + P]
+                fp2 = vals[P_LEN + P : P_LEN + 2 * P]
                 for i, p in enumerate(self._tprops):
                     if (new_bits >> i) & 1 and p.name not in self._discovery_fps:
                         self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
@@ -879,29 +893,26 @@ class TpuBfsChecker(HostEngineBase):
                 host_dirty = True
 
             if host_dirty:
-                params_in = jnp.asarray(
-                    np.array(
-                        [
-                            head,
-                            count,
-                            self._unique,
-                            rec_bits,
-                            depth_limit,
-                            grow_limit,
-                            high_water,
-                            max_steps,
-                            0,
-                            0,
-                            0,
-                            0,
-                            take_cap,
-                            fin_any,
-                            fin_all,
-                            fin_all_en,
-                        ],
-                        dtype=np.uint32,
-                    )
-                )
+                arr = np.zeros(P_LEN + 2 * P, dtype=np.uint32)
+                arr[:P_LEN] = [
+                    head,
+                    count,
+                    self._unique,
+                    rec_bits,
+                    depth_limit,
+                    grow_limit,
+                    high_water,
+                    max_steps,
+                    0,
+                    0,
+                    0,
+                    0,
+                    take_cap,
+                    fin_any,
+                    fin_all,
+                    fin_all_en,
+                ]
+                params_in = jnp.asarray(arr)
             else:
                 params_in = params_dev
             last_max_steps = max_steps
@@ -1057,7 +1068,12 @@ class TpuBfsChecker(HostEngineBase):
         from ..ops import visited_set as vs
 
         if not hasattr(self, "_table_np"):
-            self._table_np = tuple(np.asarray(l) for l in self._table_dev)
+            import jax.numpy as jnp
+
+            # Stack on device, download ONCE (per-lane downloads cost a
+            # ~100ms round-trip each on this platform).
+            stacked = np.asarray(jnp.stack(self._table_dev))
+            self._table_np = tuple(stacked[t] for t in range(4))
         chain = [fp64]
         cur = fp64
         for _ in range(10_000_000):
