@@ -1,0 +1,24 @@
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+if __name__ == "__main__":
+    tm = TwoPhaseTensor(10)
+    opts = dict(
+        chunk_size=8192,
+        queue_capacity=1 << 21,
+        table_capacity=1 << 24,
+        sync_steps=128,
+    )
+    t0 = time.perf_counter()
+    c = TensorModelAdapter(tm).checker().symmetry().spawn_tpu_bfs(**opts).join()
+    dt = time.perf_counter() - t0
+    print(
+        f"2pc-10-sym device: secs={dt:.1f} unique={c.unique_state_count()} "
+        f"gen={c.state_count()} tel={c.telemetry()}",
+        flush=True,
+    )
+    assert c.discovery("consistent") is None
+    assert c.discovery("abort agreement") is not None
+    print("verdicts ok", flush=True)

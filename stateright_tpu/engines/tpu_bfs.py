@@ -150,6 +150,11 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     expand_lean = build_expand_lean(tm, props, chunk)
     qmask = qcap - 1
     vcap = _vcap(A, chunk)
+    rcap = max(128 * A, vcap // 2)  # distinct-candidate (probe) width
+    # Dedup scratch ~4x the valid width: cross-key collisions (which
+    # harmlessly retain duplicates) stay rare, and the scratch stays small
+    # enough to be cache-hot.
+    dedup_cap = 1 << max(1, (4 * vcap - 1).bit_length())
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def loop(table, queue, rec_fp1, rec_fp2, params):
@@ -248,31 +253,44 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 # ever see representatives.
                 cl = tm.representative_lanes(jnp, cl)
             ch1, ch2 = hash_lanes_jnp(cl)
-            src = vids % u(chunk)  # parent row of candidate a*C+c is c
-            cp1 = jnp.where(vvalid, row_h1[src], u(0))
-            cp2 = jnp.where(vvalid, row_h2[src], u(0))
-            cebits = ex.ebits[src]
-            cdepth = depth[src] + u(1)
+            # Stage-2 compaction: in-batch dedup (claim-arbitrated,
+            # approximate — the insert arbitrates leftovers exactly) then
+            # re-compact to the distinct-candidate width, so the probe
+            # batch, parent lookups, AND the ring append all run at rcap
+            # (~half of vcap) instead of the valid width. The dedup's
+            # scratch is small (cache-hot), so its four random ops cost
+            # far less than the width they save downstream.
+            reps = fr.claim_dedup(ch1, ch2, vvalid, dedup_cap)
+            dids, dvalid, n_d = vs._compact_ids(reps, rcap)
+            dh1 = ch1[dids]
+            dh2 = ch2[dids]
+            dl = tuple(cl[s][dids] for s in range(S))
+            src = vids[dids] % u(chunk)  # parent row of candidate a*C+c is c
+            dp1 = jnp.where(dvalid, row_h1[src], u(0))
+            dp2 = jnp.where(dvalid, row_h2[src], u(0))
+            debits = ex.ebits[src]
+            ddepth = depth[src] + u(1)
             table, c_new, unresolved, _n_ovf = vs.insert(
-                table, ch1, ch2, cp1, cp2, vvalid
+                table, dh1, dh2, dp1, dp2, dvalid
             )
             unres = unresolved.sum(dtype=jnp.uint32)
             new_count = c_new.sum(dtype=jnp.uint32)
 
-            # Overflow (> vcap valid candidates, OR probe-tail overflow
-            # reported as unresolved candidates) => PARTIAL step: the
-            # inserted prefix is enqueued (inserts are idempotent and
-            # enqueue==inserted keeps them exactly-once), but the pops are
-            # NOT consumed — the same parents re-expand with a halved
-            # take_cap until everything fits/resolves. take_cap creeps
-            # back up on success. Unresolved candidates are only FATAL
-            # when the batch cannot shrink further (take == 1): that means
-            # genuinely exhausted probe chains, i.e. state loss.
+            # Overflow (> vcap valid candidates, > rcap distinct
+            # candidates, OR probe-tail overflow reported as unresolved
+            # candidates) => PARTIAL step: the inserted prefix is enqueued
+            # (inserts are idempotent and enqueue==inserted keeps them
+            # exactly-once), but the pops are NOT consumed — the same
+            # parents re-expand with a halved take_cap until everything
+            # fits/resolves. take_cap creeps back up on success.
+            # Unresolved candidates are only FATAL when the batch cannot
+            # shrink further (take == 1): that means genuinely exhausted
+            # probe chains, i.e. state loss.
             err_cnt = err_cnt + jnp.where(take <= u(1), unres, u(0))
-            ovf = (n_val > u(vcap)) | (unres > u(0))
+            ovf = (n_val > u(vcap)) | (n_d > u(rcap)) | (unres > u(0))
             tail = (head + count) & u(qmask)
             queue = fr.ring_scatter(
-                queue, tail, cl + (cebits, cdepth), c_new
+                queue, tail, dl + (debits, ddepth), c_new
             )
 
             consumed = jnp.where(ovf, u(0), take)

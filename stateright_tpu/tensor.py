@@ -241,14 +241,30 @@ class TensorModelAdapter(Model):
 
 
 class CanonicalTensorAdapter(TensorModelAdapter):
-    """Adapter view whose fingerprints are of canonical REPRESENTATIVES.
+    """Adapter view living entirely in CANONICAL (representative) space.
 
     Used for path reconstruction of symmetry-reduced device runs: the
-    visited table stores representative fingerprints, so the chain walker
-    must match raw successors by their canonical fingerprint. Successor
-    sets of equivalent states are equivalent, so walking raw states while
-    matching canonical fingerprints reconstructs a valid witness path.
+    engine explores rep(init) and rep(step(rep_state)), so the chain
+    walker must do exactly the same — init states and successors are
+    canonicalized before matching. (Walking RAW states and matching by
+    canonical fingerprint is NOT sufficient: with an imperfect
+    canonicalizer — the reference's own — equivalent states may map to
+    different representatives, so a raw walk can diverge from the
+    canonical chain; observed at 2pc-10 depth.) The reported path is a
+    sequence of representative states, each one actually explored by the
+    engine.
     """
+
+    def init_states(self):
+        return [
+            self.representative_state(s) for s in super().init_states()
+        ]
+
+    def next_state(self, last_state, action: int):
+        nxt = super().next_state(last_state, action)
+        if nxt is None:
+            return None
+        return self.representative_state(nxt)
 
     def fingerprint_state(self, state) -> int:
         return self.tm.fingerprint_row(
