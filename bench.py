@@ -17,18 +17,20 @@ with min/max spread (bench.sh runs each workload 3x for the same reason);
 all timings are call + host-readback wall time (jax.block_until_ready
 does not block on this platform).
 
-Workload parity vs /root/reference/bench.sh:27-34:
-  - `2pc check 10`  -> device exhaustive run (61,515,776 golden)
-  - `paxos check 6` -> paxos-3 on device (the BASELINE.json north star;
-    paxos-6's space is beyond any single-machine run — measured growth
-    x70/client puts it at ~10^12 states; the reference itself could not
-    complete it, see detail.paxos_scaling) plus a paxos-4 frontier probe
+Workload parity vs /root/reference/bench.sh:27-34 — every workload now
+runs EXHAUSTIVELY ON DEVICE:
+  - `2pc check 10`  -> 61,515,776 golden (and 265,719-representative
+    canonical closure under device symmetry, 231x reduction)
+  - `paxos check 6` -> 9,357,525 golden (plus paxos-3, the BASELINE.json
+    north star; space growth measured at ~x2/client past c=3 with the
+    capacity and ballot-round encoding guards quiet)
   - `single-copy-register check 4` -> 3x2 TTFC line
   - `linearizable-register check 2` -> ABD-2 device exhaustive (544)
   - `linearizable-register check 3 ordered` -> ABD-3-ordered device
     exhaustive (46,516) via the round-5 ordered-network lane encoding
-Plus: device symmetry reduction (2pc-5 canonical closure), batched
-device simulation TTFC, and the fused seed+first-era TTFC lines.
+Plus: device symmetry reduction, batched device simulation TTFC, and the
+fused seed+first-era TTFC lines. Full bench is ~35-45 minutes; sections
+are ordered cheapest-first and every section re-emits the JSON line.
 """
 
 import json
@@ -247,6 +249,33 @@ def main() -> None:
         "full_space": 8832,
         "reduction": round(8832 / devs.unique_state_count(), 2),
         "secs_median": round(meds, 3),
+    }
+
+    # --- 2pc-10 with device symmetry: the state-space lever at scale ------
+    # Canonical closure of the 61,515,776-state space: 265,719
+    # representatives (231x fewer), verdicts identical. One run (the full
+    # space is the tpc10_device section below).
+    t0 = time.perf_counter()
+    d10s = (
+        TensorModelAdapter(TwoPhaseTensor(10))
+        .checker()
+        .symmetry()
+        .spawn_tpu_bfs(
+            chunk_size=8192,
+            queue_capacity=1 << 21,
+            table_capacity=1 << 24,
+            sync_steps=128,
+        )
+        .join()
+    )
+    secs10s = time.perf_counter() - t0
+    assert d10s.unique_state_count() == 265_719, d10s.unique_state_count()
+    assert d10s.discovery("consistent") is None
+    detail["tpc10_symmetry"] = {
+        "unique_representatives": d10s.unique_state_count(),
+        "full_space": TPC10_GOLDEN,
+        "reduction": round(TPC10_GOLDEN / d10s.unique_state_count(), 1),
+        "secs": round(secs10s, 1),
     }
 
     # --- TTFC: increment race (BFS, fused seed+first-era) ------------------
