@@ -92,33 +92,49 @@ def _worker(
             else:
                 out_qs[w].put(batch)
 
-    def report(idle):
+    def report(kind, epoch=0):
         nonlocal last_report
-        now = time.monotonic()
-        if not idle and now - last_report < 0.05:
-            return
-        last_report = now
+        if kind == "progress":
+            now = time.monotonic()
+            if now - last_report < 0.05:
+                return
+            last_report = now
         res_q.put(
             (
-                "progress",
+                kind,
                 wid,
+                epoch,
                 state_count,
                 len(visited),
                 max_depth,
                 sent,
                 received,
-                idle,
+                not pending,
                 dict(discoveries),
             )
         )
 
     while True:
-        # Drain control messages (stop / progress request).
+        # Drain control messages (stop / termination-poll epochs). Poll
+        # replies are the ONLY input to the coordinator's quiescence
+        # decision: they carry counts sampled at reply time, tagged with
+        # the epoch, so the coordinator never reasons from stale
+        # unsolicited snapshots (a stale-pair race ended runs early in
+        # round-5 verification).
         try:
             while True:
                 msg = ctl_q.get_nowait()
                 if msg == "stop":
                     stop = True
+                elif isinstance(msg, tuple) and msg[0] == "poll":
+                    # Answer AFTER draining the inbox so "idle" reflects
+                    # everything already delivered to us.
+                    try:
+                        while True:
+                            accept(in_q.get_nowait())
+                    except queue_mod.Empty:
+                        pass
+                    report("poll_reply", msg[1])
         except queue_mod.Empty:
             pass
         if stop:
@@ -135,7 +151,6 @@ def _worker(
             pass
 
         if not pending:
-            report(idle=True)
             if not drained:
                 time.sleep(0.002)
             continue
@@ -187,12 +202,12 @@ def _worker(
                     if (ebits >> i) & 1 and prop.name not in discoveries:
                         discoveries[prop.name] = fp
         flush_out(buckets)
-        report(idle=False)
+        report("progress")
 
-    # Final: one last exact progress report, then the visited table for
-    # path reconstruction.
+    # Final: one last exact report, then the visited table for path
+    # reconstruction.
     last_report = 0.0
-    report(idle=True)
+    report("final")
     res_q.put(("table", wid, visited))
 
 
@@ -266,21 +281,48 @@ class ParallelBfsChecker(HostEngineBase):
             w: dict(sc=0, uniq=0, maxd=0, sent=0, recv=0, idle=False, disc={})
             for w in range(n)
         }
-        quiet_polls = 0
+
+        def ingest(msg):
+            _, wid, _epoch, sc, uniq, maxd, sent, recv, idle, disc = msg
+            stats[wid] = dict(
+                sc=sc, uniq=uniq, maxd=maxd, sent=sent, recv=recv,
+                idle=idle, disc=disc,
+            )
+            for name, fp in disc.items():
+                self._discovery_fps.setdefault(name, fp)
+
+        # Termination: coordinator-driven polling epochs. Each epoch
+        # broadcasts a poll; every worker replies with counts sampled at
+        # reply time (after draining its inbox). The run is quiescent when
+        # TWO consecutive epochs each show all workers idle with global
+        # sent == received (+ seeds) AND identical totals across the two
+        # epochs — a message in flight at the first epoch either still
+        # shows sent > received at the second, or its delivery changes the
+        # totals; either way the pair is rejected. (Unsolicited progress
+        # reports feed counters/discoveries only, never this decision:
+        # stale-snapshot pairs can momentarily balance — observed as a
+        # premature stop in round-5 verification.)
+        prev_quiet_totals = None
+        epoch = 0
         try:
             while True:
-                try:
-                    msg = res_q.get(timeout=0.05)
-                except queue_mod.Empty:
-                    msg = None
-                if msg is not None and msg[0] == "progress":
-                    _, wid, sc, uniq, maxd, sent, recv, idle, disc = msg
-                    stats[wid] = dict(
-                        sc=sc, uniq=uniq, maxd=maxd, sent=sent, recv=recv,
-                        idle=idle, disc=disc,
-                    )
-                    for name, fp in disc.items():
-                        self._discovery_fps.setdefault(name, fp)
+                epoch += 1
+                for w in range(n):
+                    ctl_qs[w].put(("poll", epoch))
+                replies = {}
+                deadline = time.monotonic() + 5.0
+                while len(replies) < n and time.monotonic() < deadline:
+                    try:
+                        msg = res_q.get(timeout=0.05)
+                    except queue_mod.Empty:
+                        continue
+                    if msg[0] in ("progress", "final"):
+                        ingest(msg)
+                    elif msg[0] == "poll_reply":
+                        ingest(msg)
+                        if msg[2] == epoch:
+                            replies[msg[1]] = msg
+
                 self._state_count = sum(s["sc"] for s in stats.values())
                 self._unique = sum(s["uniq"] for s in stats.values())
                 self._max_depth = max(
@@ -296,17 +338,20 @@ class ParallelBfsChecker(HostEngineBase):
                     break
                 if self._timed_out():
                     break
-                # Double-count quiescence: all idle AND global sent ==
-                # global received (+ seeds) on two consecutive polls.
-                all_idle = all(s["idle"] for s in stats.values())
-                g_sent = sum(s["sent"] for s in stats.values()) + n_seeded
-                g_recv = sum(s["recv"] for s in stats.values())
-                if all_idle and g_sent == g_recv:
-                    quiet_polls += 1
-                    if quiet_polls >= 2:
-                        break
+
+                if len(replies) == n:
+                    all_idle = all(r[8] for r in replies.values())
+                    g_sent = sum(r[6] for r in replies.values()) + n_seeded
+                    g_recv = sum(r[7] for r in replies.values())
+                    totals = (g_sent, g_recv)
+                    if all_idle and g_sent == g_recv:
+                        if prev_quiet_totals == totals:
+                            break
+                        prev_quiet_totals = totals
+                    else:
+                        prev_quiet_totals = None
                 else:
-                    quiet_polls = 0
+                    prev_quiet_totals = None
         finally:
             for w in range(n):
                 ctl_qs[w].put("stop")
@@ -319,14 +364,8 @@ class ParallelBfsChecker(HostEngineBase):
                     continue
                 if msg[0] == "table":
                     tables[msg[1]] = msg[2]
-                elif msg[0] == "progress":
-                    _, wid, sc, uniq, maxd, sent, recv, idle, disc = msg
-                    stats[wid] = dict(
-                        sc=sc, uniq=uniq, maxd=maxd, sent=sent, recv=recv,
-                        idle=idle, disc=disc,
-                    )
-                    for name, fp in disc.items():
-                        self._discovery_fps.setdefault(name, fp)
+                else:
+                    ingest(msg)
             self._tables = [tables.get(w, {}) for w in range(n)]
             self._state_count = sum(s["sc"] for s in stats.values())
             self._unique = sum(s["uniq"] for s in stats.values())
