@@ -17,20 +17,23 @@ with min/max spread (bench.sh runs each workload 3x for the same reason);
 all timings are call + host-readback wall time (jax.block_until_ready
 does not block on this platform).
 
-Workload parity vs /root/reference/bench.sh:27-34 — every workload now
-runs EXHAUSTIVELY ON DEVICE:
-  - `2pc check 10`  -> 61,515,776 golden (and 265,719-representative
-    canonical closure under device symmetry, 231x reduction)
-  - `paxos check 6` -> 9,357,525 golden (plus paxos-3, the BASELINE.json
-    north star; space growth measured at ~x2/client past c=3 with the
-    capacity and ballot-round encoding guards quiet)
-  - `single-copy-register check 4` -> 3x2 TTFC line
-  - `linearizable-register check 2` -> ABD-2 device exhaustive (544)
-  - `linearizable-register check 3 ordered` -> ABD-3-ordered device
-    exhaustive (46,516) via the round-5 ordered-network lane encoding
+Workload parity vs /root/reference/bench.sh:27-34:
+  - `2pc check 10`  -> device exhaustive, 61,515,776 golden (and the
+    265,719-representative canonical closure under device symmetry,
+    231x reduction)
+  - `paxos check 6` -> device exhaustive, 9,357,525 golden (plus
+    paxos-3, the BASELINE.json north star; space growth measured at
+    ~x2/client past c=3 with the capacity and ballot-round encoding
+    guards quiet)
+  - `single-copy-register check 4` -> represented by the 3x2
+    time-to-first-counterexample line (first linearizability violation,
+    not an exhaustive count)
+  - `linearizable-register check 2` -> device exhaustive (544)
+  - `linearizable-register check 3 ordered` -> device exhaustive
+    (46,516) via the round-5 ordered-network lane encoding
 Plus: device symmetry reduction, batched device simulation TTFC, and the
 fused seed+first-era TTFC lines. Full bench is ~35-45 minutes; sections
-are ordered cheapest-first and every section re-emits the JSON line.
+run cheapest-first and each one re-emits the JSON line when it lands.
 """
 
 import json
@@ -47,6 +50,7 @@ TPC7_GOLDEN = 296_448  # EXACT-row oracle count of TwoPhaseTensor(7)
 TPC10_GOLDEN = 61_515_776  # threaded-host exhaustive run (round 4)
 ABD3_ORDERED_GOLDEN = 46_516  # host actor-model exhaustive run (round 5)
 TPC5_SYM_CLOSURE = 1_092  # deterministic canonical-closure golden
+TPC10_SYM_CLOSURE = 265_719  # deterministic canonical-closure golden
 
 
 def timed3(mk_checker, golden=None, check=None):
@@ -251,33 +255,6 @@ def main() -> None:
         "secs_median": round(meds, 3),
     }
 
-    # --- 2pc-10 with device symmetry: the state-space lever at scale ------
-    # Canonical closure of the 61,515,776-state space: 265,719
-    # representatives (231x fewer), verdicts identical. One run (the full
-    # space is the tpc10_device section below).
-    t0 = time.perf_counter()
-    d10s = (
-        TensorModelAdapter(TwoPhaseTensor(10))
-        .checker()
-        .symmetry()
-        .spawn_tpu_bfs(
-            chunk_size=8192,
-            queue_capacity=1 << 21,
-            table_capacity=1 << 24,
-            sync_steps=128,
-        )
-        .join()
-    )
-    secs10s = time.perf_counter() - t0
-    assert d10s.unique_state_count() == 265_719, d10s.unique_state_count()
-    assert d10s.discovery("consistent") is None
-    detail["tpc10_symmetry"] = {
-        "unique_representatives": d10s.unique_state_count(),
-        "full_space": TPC10_GOLDEN,
-        "reduction": round(TPC10_GOLDEN / d10s.unique_state_count(), 1),
-        "secs": round(secs10s, 1),
-    }
-
     # --- TTFC: increment race (BFS, fused seed+first-era) ------------------
     # One dispatch + one readback end to end: seeding, the era loop, AND
     # the discovery fingerprints all ride a single device round-trip.
@@ -331,81 +308,145 @@ def main() -> None:
 
     emit(dev_rate, vs_threaded, partial=True)
 
-    # --- paxos-3: the BASELINE.json north-star workload -------------------
-    px3 = PaxosTensorExhaustive(3)
-    opts3 = dict(
-        chunk_size=16384, queue_capacity=1 << 21, table_capacity=1 << 26
-    )
-    TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()  # compile
-    t0 = time.perf_counter()
-    d3 = TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()
-    secs3 = time.perf_counter() - t0
-    assert d3.unique_state_count() == PAXOS3_GOLDEN, d3.unique_state_count()
-    detail["paxos3"] = {
-        "states_per_sec": round(d3.state_count() / secs3, 1),
-        "unique": d3.unique_state_count(),
-        "secs": round(secs3, 3),
-        "golden_match": True,
-    }
-    emit(dev_rate, vs_threaded, partial=True)
+    def section(name, fn):
+        """Run one big device section; a PLATFORM failure (remote-compile
+        hiccup, worker restart) records the error and lets later sections
+        run — a golden mismatch (AssertionError) still fails the bench
+        loudly. (Observed round 5: a transient 'remote_compile: response
+        body closed' killed an otherwise-green bench mid-run.)"""
+        try:
+            fn()
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 - platform fault tolerance
+            detail[name] = {"error": repr(e)[:200]}
+        emit(dev_rate, vs_threaded, partial=True)
 
-    # --- paxos check 6: bench.sh:31 parity — ON DEVICE (round 5) ----------
-    # The full reference bench workload, checked exhaustively: 9,357,525
-    # uniques, golden-matched against the threaded host's 17-minute run
-    # (the device does it in ~8). Encoding guards (network capacity,
-    # ballot-round range) asserted quiet.
-    px6 = PaxosTensorExhaustive(6)
-    t0 = time.perf_counter()
-    d6 = (
-        TensorModelAdapter(px6)
-        .checker()
-        .spawn_tpu_bfs(
+    def _sec_tpc10_symmetry():
+        # --- 2pc-10 with device symmetry: the state-space lever at scale ------
+        # Canonical closure of the 61,515,776-state space: 265,719
+        # representatives (231x fewer), verdicts identical. One run, WARMED
+        # (the first call compiles the loop for this shape; the timed call
+        # reuses it), because a full closure takes ~45s — the 3x-median
+        # discipline is reserved for the sub-minute sections.
+        sym10opts = dict(
             chunk_size=8192,
             queue_capacity=1 << 21,
-            table_capacity=1 << 26,
+            table_capacity=1 << 24,
             sync_steps=128,
         )
-        .join()
-    )
-    secs6 = time.perf_counter() - t0
-    assert d6.unique_state_count() == PAXOS6_GOLDEN, d6.unique_state_count()
-    assert d6.discovery("network within capacity") is None
-    assert d6.discovery("ballot rounds within range") is None
-    detail["paxos6"] = {
-        "states_per_sec": round(d6.state_count() / secs6, 1),
-        "unique": d6.unique_state_count(),
-        "secs": round(secs6, 1),
-        "golden_match": True,
-        "host_threaded_secs": 1037.3,
-    }
-    emit(dev_rate, vs_threaded, partial=True)
+        tm10 = TwoPhaseTensor(10)
+        TensorModelAdapter(tm10).checker().symmetry().spawn_tpu_bfs(
+            **sym10opts
+        ).join()  # compile
+        t0 = time.perf_counter()
+        d10s = (
+            TensorModelAdapter(tm10)
+            .checker()
+            .symmetry()
+            .spawn_tpu_bfs(**sym10opts)
+            .join()
+        )
+        secs10s = time.perf_counter() - t0
+        assert d10s.unique_state_count() == TPC10_SYM_CLOSURE, (
+            d10s.unique_state_count()
+        )
+        assert d10s.discovery("consistent") is None
+        detail["tpc10_symmetry"] = {
+            "unique_representatives": d10s.unique_state_count(),
+            "full_space": TPC10_GOLDEN,
+            "reduction": round(TPC10_GOLDEN / d10s.unique_state_count(), 1),
+            "secs": round(secs10s, 1),
+        }
 
-    # --- 2pc check 10: bench.sh:28 scale parity — ON DEVICE (round 5) -----
-    # 61,515,776 uniques checked exhaustively by the device engine (the
-    # round-4 worker crash was long single dispatches; short eras fixed
-    # it). The threaded host cross-check ran in round 4 (3.84M st/s).
-    t0 = time.perf_counter()
-    d10 = (
-        TensorModelAdapter(TwoPhaseTensor(10))
-        .checker()
-        .spawn_tpu_bfs(
-            chunk_size=12288,
-            queue_capacity=1 << 24,
-            table_capacity=1 << 28,
-            sync_steps=128,
+    def _sec_paxos3():
+        # --- paxos-3: the BASELINE.json north-star workload -------------------
+        px3 = PaxosTensorExhaustive(3)
+        opts3 = dict(
+            chunk_size=16384, queue_capacity=1 << 21, table_capacity=1 << 26
         )
-        .join()
+        TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()  # compile
+        t0 = time.perf_counter()
+        d3 = TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()
+        secs3 = time.perf_counter() - t0
+        assert d3.unique_state_count() == PAXOS3_GOLDEN, d3.unique_state_count()
+        detail["paxos3"] = {
+            "states_per_sec": round(d3.state_count() / secs3, 1),
+            "unique": d3.unique_state_count(),
+            "secs": round(secs3, 3),
+            "golden_match": True,
+        }
+
+    def _sec_paxos6():
+        # --- paxos check 6: bench.sh:31 parity — ON DEVICE (round 5) ----------
+        # The full reference bench workload, checked exhaustively: 9,357,525
+        # uniques, golden-matched against the threaded host's 17-minute run
+        # (the device does it in ~8). Encoding guards (network capacity,
+        # ballot-round range) asserted quiet.
+        px6 = PaxosTensorExhaustive(6)
+        t0 = time.perf_counter()
+        d6 = (
+            TensorModelAdapter(px6)
+            .checker()
+            .spawn_tpu_bfs(
+                chunk_size=8192,
+                queue_capacity=1 << 21,
+                table_capacity=1 << 26,
+                sync_steps=128,
+            )
+            .join()
+        )
+        secs6 = time.perf_counter() - t0
+        assert d6.unique_state_count() == PAXOS6_GOLDEN, d6.unique_state_count()
+        assert d6.discovery("network within capacity") is None
+        assert d6.discovery("ballot rounds within range") is None
+        detail["paxos6"] = {
+            "states_per_sec": round(d6.state_count() / secs6, 1),
+            "unique": d6.unique_state_count(),
+            "secs": round(secs6, 1),
+            "golden_match": True,
+            "host_threaded_secs": 1037.3,
+        }
+
+    def _sec_tpc10_device():
+        # --- 2pc check 10: bench.sh:28 scale parity — ON DEVICE (round 5) -----
+        # 61,515,776 uniques checked exhaustively by the device engine (the
+        # round-4 worker crash was long single dispatches; short eras fixed
+        # it). The threaded host cross-check ran in round 4 (3.84M st/s).
+        t0 = time.perf_counter()
+        d10 = (
+            TensorModelAdapter(TwoPhaseTensor(10))
+            .checker()
+            .spawn_tpu_bfs(
+                chunk_size=12288,
+                queue_capacity=1 << 24,
+                table_capacity=1 << 28,
+                sync_steps=128,
+            )
+            .join()
+        )
+        secs10 = time.perf_counter() - t0
+        assert d10.unique_state_count() == TPC10_GOLDEN, d10.unique_state_count()
+        detail["tpc10_device"] = {
+            "states_per_sec": round(d10.state_count() / secs10, 1),
+            "unique": d10.unique_state_count(),
+            "secs": round(secs10, 1),
+            "golden_match": True,
+            "telemetry": d10.telemetry(),
+        }
+
+    section("tpc10_symmetry", _sec_tpc10_symmetry)
+    section("paxos3", _sec_paxos3)
+    section("paxos6", _sec_paxos6)
+    section("tpc10_device", _sec_tpc10_device)
+
+    # partial stays True if any section recorded a (platform) error: the
+    # final line only claims completeness when every golden actually ran.
+    any_errors = any(
+        isinstance(v, dict) and "error" in v for v in detail.values()
     )
-    secs10 = time.perf_counter() - t0
-    assert d10.unique_state_count() == TPC10_GOLDEN, d10.unique_state_count()
-    detail["tpc10_device"] = {
-        "states_per_sec": round(d10.state_count() / secs10, 1),
-        "unique": d10.unique_state_count(),
-        "secs": round(secs10, 1),
-        "golden_match": True,
-        "telemetry": d10.telemetry(),
-    }
-    emit(dev_rate, vs_threaded, partial=False)
+
+    emit(dev_rate, vs_threaded, partial=any_errors)
 
 
 if __name__ == "__main__":

@@ -63,7 +63,8 @@ P_GEN0 = 6  # generated before this era (for the target check)
 P_GEN = 7  # OUT: generated states total after era
 P_STEPS = 8  # OUT: device steps executed this era
 P_MAXD = 9  # OUT: max walk length seen
-P_LEN = 10
+P_SEED = 10  # master seed (consumed by the fused seed+first-era dispatch)
+P_LEN = 11
 
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
@@ -72,7 +73,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
     key = (id(tm), B, L, len(props))
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
-        return cached[1]
+        return cached[1]  # (loop, seed_run, n_init)
     while len(_LOOP_CACHE) >= 16:
         _LOOP_CACHE.pop(next(iter(_LOOP_CACHE)))
 
@@ -87,6 +88,13 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
     P = len(props)
 
     init_np = np.asarray(tm.init_states_array(), dtype=np.uint32)
+    # Boundary-filter init states at build time (host-side, static) so the
+    # fused device seeder and the host path agree on the init set.
+    _inb = np.asarray(
+        tm.within_boundary_lanes(np, tuple(init_np[:, s] for s in range(S))),
+        dtype=bool,
+    )
+    init_np = init_np[_inb]
     n_init = len(init_np)
     init_lanes_const = tuple(init_np[:, s] for s in range(S))
 
@@ -106,7 +114,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         return x ^ (x >> u(16))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def loop(walk, fp1buf, fp2buf, rec_fp1, rec_fp2, params):
+    def loop(walk, fp1buf, fp2buf, params):
         """walk = (rows[S], seed, ptr, ebits) lanes of [B];
         fp*buf = [B * L] flat path buffers."""
         u = jnp.uint32
@@ -122,7 +130,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         inits = tuple(jnp.asarray(l) for l in init_lanes_const)
 
         def cond(carry):
-            (_w, _f1, _f2, gen, steps, rec_acc, _h, _p1, _p2, _pl, maxd) = carry
+            (_w, _f1, _f2, gen, steps, rec_acc, _h, _pl, maxd) = carry
             fin_hit = ((rec_acc & fin_any) != u(0)) | (
                 (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
             )
@@ -138,8 +146,6 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
                 steps,
                 rec_acc,
                 hseen,
-                pf1,
-                pf2,
                 plen,
                 maxd,
             ) = carry
@@ -209,14 +215,6 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             for i in range(P):
                 hits = prop_hits[i]
                 first = hits & ~hseen[i]
-                pf1 = tuple(
-                    jnp.where(first, h1, pf1[j]) if j == i else pf1[j]
-                    for j in range(P)
-                )
-                pf2 = tuple(
-                    jnp.where(first, h2, pf2[j]) if j == i else pf2[j]
-                    for j in range(P)
-                )
                 plen = tuple(
                     jnp.where(first, ptr, plen[j]) if j == i else plen[j]
                     for j in range(P)
@@ -274,8 +272,6 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
                 steps,
                 rec_acc,
                 hseen,
-                pf1,
-                pf2,
                 plen,
                 maxd,
             )
@@ -292,8 +288,6 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             rec_bits0,
             tuple(false_b for _ in range(P)),
             tuple(zero_b for _ in range(P)),
-            tuple(zero_b for _ in range(P)),
-            tuple(zero_b for _ in range(P)),
             zero_b,
         )
         (
@@ -304,8 +298,6 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             steps,
             rec_acc,
             hseen,
-            pf1,
-            pf2,
             plen,
             maxd,
         ) = lax.while_loop(cond, body, init_carry)
@@ -319,32 +311,63 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         for i in range(P):
             found = jnp.any(hseen[i])
             sel = jnp.argmin(jnp.where(hseen[i], plen[i], u(0xFFFFFFFF)))
-            take_new = found & (((rec_bits_out >> u(i)) & u(1)) == u(0))
-            rec_fp1 = rec_fp1.at[i].set(jnp.where(take_new, pf1[i][sel], rec_fp1[i]))
-            rec_fp2 = rec_fp2.at[i].set(jnp.where(take_new, pf2[i][sel], rec_fp2[i]))
             disc_walk = disc_walk.at[i].set(sel.astype(u))
             disc_plen = disc_plen.at[i].set(plen[i][sel])
             rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
 
         walk_out = tuple(rows) + (seed, ptr, ebits)
-        params_out = jnp.stack(
+        # Discovery walk indices and path lengths ride the params tail so
+        # the era result is ONE download (each separate device read costs
+        # ~100ms here — the simulation TTFC floor).
+        params_out = jnp.concatenate(
             [
-                rec_bits_out,
-                params[P_MAX_STEPS],
-                params[P_FIN_ANY],
-                params[P_FIN_ALL],
-                params[P_FIN_ALL_EN],
-                params[P_TARGET_GEN],
-                gen0 + gen,
-                gen0 + gen,
-                steps,
-                maxd.max(),
+                jnp.stack(
+                    [
+                        rec_bits_out,
+                        params[P_MAX_STEPS],
+                        params[P_FIN_ANY],
+                        params[P_FIN_ALL],
+                        params[P_FIN_ALL_EN],
+                        params[P_TARGET_GEN],
+                        gen0 + gen,
+                        gen0 + gen,
+                        steps,
+                        maxd.max(),
+                        params[P_SEED],
+                    ]
+                ),
+                disc_walk,
+                disc_plen,
             ]
         )
-        return walk_out, fp1buf, fp2buf, rec_fp1, rec_fp2, params_out, disc_walk, disc_plen
+        return walk_out, fp1buf, fp2buf, params_out
 
-    _LOOP_CACHE[key] = (tm, loop)
-    return loop
+    @jax.jit
+    def seed_run(params):
+        """Fused seeding + first era: ONE small upload, walk state and
+        path buffers created on device (host<->device round-trips are the
+        TTFC floor on this platform; the walk lanes would otherwise cost
+        an upload each). Walk 0 uses the master seed directly for
+        reproducibility parity with the host engine (simulation.rs:154)."""
+        u = jnp.uint32
+        master = params[P_SEED]
+        iota_b = jnp.arange(B, dtype=u)
+        seeds = prng(master ^ (iota_b * u(0x9E3779B9)))
+        seeds = seeds.at[0].set(master)
+        picks = prng(seeds) % u(n_init)
+        rows = tuple(jnp.asarray(l)[picks] for l in init_lanes_const)
+        walk = rows + (
+            seeds,
+            jnp.zeros(B, dtype=u),
+            jnp.full(B, init_ebits, dtype=u),
+        )
+        fp1buf = jnp.zeros(B * L, dtype=u)
+        fp2buf = jnp.zeros(B * L, dtype=u)
+        return loop(walk, fp1buf, fp2buf, params)
+
+
+    _LOOP_CACHE[key] = (tm, (loop, seed_run, n_init))
+    return loop, seed_run, n_init
 
 
 class TpuSimulationChecker(HostEngineBase):
@@ -390,16 +413,10 @@ class TpuSimulationChecker(HostEngineBase):
         self._sync = sync_steps
         self._discovery_paths: Dict[str, List[int]] = {}
         self._telemetry: Dict[str, Any] = {"eras": 0, "steps": 0, "restid": 0}
-        self._loop = _build_sim_loop(self.tm, self._tprops, self._B, self._L)
+        self._loop, self._seed_run, self._n_init = _build_sim_loop(
+            self.tm, self._tprops, self._B, self._L
+        )
         self._start()
-
-    @staticmethod
-    def _prng_np(x):
-        x = np.uint64(x) & np.uint64(0xFFFFFFFF)
-        x = np.uint32(x)
-        x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
-        x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
-        return x ^ (x >> np.uint32(16))
 
     def _run(self) -> None:
         import jax.numpy as jnp
@@ -411,40 +428,10 @@ class TpuSimulationChecker(HostEngineBase):
         fin_any, fin_all, fin_all_en = self._finish_when.device_masks(
             self._tprops
         )
-        init_ebits = 0
-        e = 0
-        for p in self._tprops:
-            if p.expectation == Expectation.EVENTUALLY:
-                init_ebits |= 1 << e
-                e += 1
-
-        inits = np.asarray(tm.init_states_array(), dtype=np.uint32)
-        inb_lanes = tuple(inits[:, s] for s in range(S))
-        inb = np.asarray(tm.within_boundary_lanes(np, inb_lanes), dtype=bool)
-        inits = inits[inb]
-        if len(inits) == 0:
+        if self._n_init == 0:
+            # No in-boundary init states: the compiled seeder's modulo
+            # over n_init would be undefined — never dispatch it.
             return
-
-        # Per-walk seeds derive from the master seed; walk 0 of the first
-        # batch uses the caller's seed directly (reproducibility parity
-        # with simulation.rs:154-156).
-        iota = np.arange(B, dtype=np.uint32)
-        seeds = self._prng_np(
-            np.uint32(self._seed) ^ (iota * np.uint32(0x9E3779B9))
-        )
-        seeds[0] = np.uint32(self._seed)
-        picks = self._prng_np(seeds) % np.uint32(len(inits))
-        rows0 = inits[picks]  # [B, S]
-
-        walk = tuple(jnp.asarray(rows0[:, s]) for s in range(S)) + (
-            jnp.asarray(seeds),
-            jnp.zeros(B, dtype=jnp.uint32),
-            jnp.full(B, init_ebits, dtype=jnp.uint32),
-        )
-        fp1buf = jnp.zeros(B * L, dtype=jnp.uint32)
-        fp2buf = jnp.zeros(B * L, dtype=jnp.uint32)
-        rec_fp1 = jnp.zeros(P, dtype=jnp.uint32)
-        rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
         rec_bits = 0
         gen_total = 0
 
@@ -455,19 +442,28 @@ class TpuSimulationChecker(HostEngineBase):
         )
         target_gen = self._target_state_count or 0
 
-        params = np.zeros(P_LEN, dtype=np.uint32)
+        params = np.zeros(P_LEN + 2 * P, dtype=np.uint32)
         params[P_MAX_STEPS] = max_sync
         params[P_FIN_ANY] = fin_any
         params[P_FIN_ALL] = fin_all
         params[P_FIN_ALL_EN] = fin_all_en
         params[P_TARGET_GEN] = min(target_gen, 0xFFFFFFFF)
+        params[P_SEED] = self._seed
+
+        # Fused seeding + first era: one small upload, one dispatch (walk
+        # lanes and path buffers are created on device).
+        first = True
+        walk = fp1buf = fp2buf = None
         params_dev = jnp.asarray(params)
 
         while True:
-            (
-                walk, fp1buf, fp2buf, rec_fp1, rec_fp2, params_dev,
-                disc_walk, disc_plen,
-            ) = self._loop(walk, fp1buf, fp2buf, rec_fp1, rec_fp2, params_dev)
+            if first:
+                walk, fp1buf, fp2buf, params_dev = self._seed_run(params_dev)
+                first = False
+            else:
+                walk, fp1buf, fp2buf, params_dev = self._loop(
+                    walk, fp1buf, fp2buf, params_dev
+                )
             vals = np.asarray(params_dev)
             self._telemetry["eras"] += 1
             self._telemetry["steps"] += int(vals[P_STEPS])
@@ -478,11 +474,13 @@ class TpuSimulationChecker(HostEngineBase):
             new_bits = int(vals[P_REC])
             if new_bits != rec_bits:
                 # Extract the freshly-hit walks' fingerprint paths from the
-                # device buffers (one download per discovery era).
-                f1 = np.asarray(fp1buf).reshape(B, L)
-                f2 = np.asarray(fp2buf).reshape(B, L)
-                dw = np.asarray(disc_walk)
-                dp = np.asarray(disc_plen)
+                # device buffers: the walk/length indices came with the
+                # params download; the two path buffers stack into ONE read.
+                both = np.asarray(jnp.stack([fp1buf, fp2buf]))
+                f1 = both[0].reshape(B, L)
+                f2 = both[1].reshape(B, L)
+                dw = vals[P_LEN : P_LEN + P]
+                dp = vals[P_LEN + P : P_LEN + 2 * P]
                 for i, p in enumerate(self._tprops):
                     if not ((new_bits >> i) & 1) or p.name in self._discovery_paths:
                         continue
