@@ -17,6 +17,12 @@ with min/max spread (bench.sh runs each workload 3x for the same reason);
 all timings are call + host-readback wall time (jax.block_until_ready
 does not block on this platform).
 
+Per-phase detail: checker.telemetry() now returns the engine's metrics
+registry (obs/metrics.py) — counters, gauges, AND cumulative per-phase
+wall millis (device_era / readback / spill / refill / table_grow) — so
+the BENCH_*.json telemetry blocks carry a phase breakdown of where each
+workload's wall time went, not just end-to-end seconds.
+
 Workload parity vs /root/reference/bench.sh:27-34:
   - `2pc check 10`  -> device exhaustive, 61,515,776 golden (and the
     265,719-representative canonical closure under device symmetry,
@@ -194,6 +200,7 @@ def main() -> None:
         "unique": devp.unique_state_count(),
         "secs_median": round(medp, 3),
         "golden_match": True,
+        "telemetry": devp.telemetry(),
     }
 
     # --- linearizable-register check 2 (ABD, unordered): bench.sh:33 ------
@@ -375,6 +382,7 @@ def main() -> None:
             "unique": d3.unique_state_count(),
             "secs": round(secs3, 3),
             "golden_match": True,
+            "telemetry": d3.telemetry(),
         }
 
     def _sec_paxos6():
@@ -406,6 +414,7 @@ def main() -> None:
             "secs": round(secs6, 1),
             "golden_match": True,
             "host_threaded_secs": 1037.3,
+            "telemetry": d6.telemetry(),
         }
 
     def _sec_tpc10_device():

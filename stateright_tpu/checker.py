@@ -47,6 +47,8 @@ class CheckerBuilder:
         self.visitor_: Optional[CheckerVisitor] = None
         self.finish_when_: HasDiscoveries = HasDiscoveries.ALL
         self.timeout_: Optional[float] = None
+        self.trace_path_: Optional[str] = None
+        self.profile_dir_: Optional[str] = None
 
     # -- options ------------------------------------------------------------
 
@@ -94,6 +96,18 @@ class CheckerBuilder:
 
     def timeout(self, seconds: float) -> "CheckerBuilder":
         self.timeout_ = seconds
+        return self
+
+    def trace(self, path: str) -> "CheckerBuilder":
+        """Stream one JSONL event per era/wave/round to `path` (obs/trace.py
+        documents the event schema). Works with every engine."""
+        self.trace_path_ = path
+        return self
+
+    def profile(self, log_dir: str) -> "CheckerBuilder":
+        """Bracket the run with `jax.profiler` start/stop_trace into
+        `log_dir`. A no-op when the profiler is unavailable."""
+        self.profile_dir_ = log_dir
         return self
 
     # -- engines ------------------------------------------------------------
@@ -211,10 +225,11 @@ class Checker:
         return self
 
     def telemetry(self) -> Dict[str, Any]:
-        """Engine-internal gauges (device engines: load factor, take_cap,
-        steps/era, spill volume). Empty for engines without telemetry; an
-        occupancy or throughput regression should be visible here without
-        STPU_DEBUG."""
+        """The engine's metrics-registry snapshot: counters, gauges, and
+        cumulative per-phase wall millis (obs/metrics.py documents the
+        names). Every engine populates one registry through the common
+        API, so an occupancy or throughput regression is visible here
+        without STPU_DEBUG."""
         return {}
 
     # -- on-demand engine hooks (no-ops elsewhere; checker.rs:298-306) ------
@@ -243,6 +258,7 @@ class Checker:
         Reference: checker.rs:412-452.
         """
         start = time.monotonic()
+        target = getattr(self, "_target_state_count", None)
         snap = getattr(self, "_initial_snapshot", None)
         if snap is not None:
             reporter.report_checking(
@@ -252,6 +268,7 @@ class Checker:
                     max_depth=snap[2],
                     duration_secs=0.0,
                     done=False,
+                    target_states=target,
                 )
             )
         while not self.is_done():
@@ -262,6 +279,7 @@ class Checker:
                     max_depth=self.max_depth(),
                     duration_secs=time.monotonic() - start,
                     done=False,
+                    target_states=target,
                 )
             )
             time.sleep(reporter.delay())

@@ -43,7 +43,10 @@ class BfsChecker(HostEngineBase):
         while True:
             if not self._pending:
                 return  # work exhausted
-            self._check_block()
+            with self._metrics.phase("check_block"):
+                self._check_block()
+            self._metrics.inc("waves")
+            self._obs_event("wave", frontier=len(self._pending))
             if self._finish_matched(self._discoveries):
                 return
             if (

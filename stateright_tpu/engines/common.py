@@ -16,6 +16,8 @@ from typing import Any, Dict, List, Optional
 
 from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import TraceWriter, start_profile, stop_profile
 
 BLOCK_SIZE = 1500  # states per finish_when re-check; reference bfs.rs:130
 
@@ -52,6 +54,18 @@ class HostEngineBase(Checker):
 
         self._state_count = 0
         self._max_depth = 0
+        # Observability: one metrics registry per run (obs/metrics.py) backs
+        # Checker.telemetry() for every engine; an optional JSONL trace
+        # stream and jax.profiler bracket ride the builder options.
+        self._metrics = MetricsRegistry()
+        trace_path = getattr(builder, "trace_path_", None)
+        self._trace: Optional[TraceWriter] = (
+            TraceWriter(trace_path, engine=type(self).__name__)
+            if trace_path
+            else None
+        )
+        self._profile_dir: Optional[str] = getattr(builder, "profile_dir_", None)
+        self._last_phase_ms: Dict[str, float] = {}
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
         self._deadline = (
@@ -79,11 +93,32 @@ class HostEngineBase(Checker):
         self._thread.start()
 
     def _run_guarded(self) -> None:
+        profiling = (
+            start_profile(self._profile_dir) if self._profile_dir else False
+        )
+        if self._trace is not None:
+            self._trace.emit(
+                "run_start",
+                states=int(self._state_count),
+                unique=int(self.unique_state_count()),
+            )
         try:
             self._run()
         except BaseException as e:  # surfaces at join(), like a Rust panic
             self._error = e
         finally:
+            if profiling:
+                stop_profile()
+            if self._trace is not None:
+                self._trace.emit(
+                    "run_end",
+                    states=int(self._state_count),
+                    unique=int(self.unique_state_count()),
+                    max_depth=int(self._max_depth),
+                    phase_ms=self._metrics.phase_ms(),
+                    error=repr(self._error) if self._error else None,
+                )
+                self._trace.close()
             self._done.set()
 
     def _run(self) -> None:
@@ -106,6 +141,44 @@ class HostEngineBase(Checker):
 
     def max_depth(self) -> int:
         return self._max_depth
+
+    # -- observability ------------------------------------------------------
+
+    def telemetry(self) -> Dict[str, Any]:
+        """The run's metrics-registry snapshot (counters + gauges +
+        cumulative phase_ms; names catalogued in obs/metrics.py)."""
+        snap = self._metrics.snapshot()
+        snap["engine"] = type(self).__name__
+        return snap
+
+    def _phase_ms_delta(self) -> Dict[str, float]:
+        """Per-event phase-timer deltas (ms since the previous trace
+        event) — what the JSONL era/wave events carry."""
+        cur = self._metrics.phase_ms()
+        last = self._last_phase_ms
+        self._last_phase_ms = cur
+        return {
+            k: round(v - last.get(k, 0.0), 3)
+            for k, v in cur.items()
+            if v - last.get(k, 0.0) > 0.0 or k not in last
+        }
+
+    def _obs_event(self, event: str, frontier: int = 0, **extra: Any) -> None:
+        """Record one unit of forward progress: refresh the standard gauges
+        and, when tracing, emit the JSONL event for it."""
+        m = self._metrics
+        m.set_gauge("frontier_size", int(frontier))
+        m.set_gauge("max_depth", int(self._max_depth))
+        if self._trace is not None:
+            self._trace.emit(
+                event,
+                states=int(self._state_count),
+                unique=int(self.unique_state_count()),
+                frontier=int(frontier),
+                max_depth=int(self._max_depth),
+                phase_ms=self._phase_ms_delta(),
+                **extra,
+            )
 
     # -- shared helpers -----------------------------------------------------
 

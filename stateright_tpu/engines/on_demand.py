@@ -43,6 +43,9 @@ class OnDemandChecker(HostEngineBase):
         self._lock = threading.RLock()
         self._run_thread: Optional[threading.Thread] = None
         self._initial_snapshot = (self._state_count, self.unique_state_count(), 0)
+        # The engine idles until driven, so seed the registry at
+        # construction: telemetry() must reflect the frontier immediately.
+        self._metrics.set_gauge("frontier_size", len(self._pending))
 
     # -- lifecycle (idle until driven; no auto-started thread) ---------------
 
@@ -69,7 +72,10 @@ class OnDemandChecker(HostEngineBase):
             for i, job in enumerate(self._pending):
                 if job[1] == fingerprint:
                     del self._pending[i]
-                    self._process_job(job)
+                    self._metrics.inc("expand_requests")
+                    with self._metrics.phase("check_block"):
+                        self._process_job(job)
+                    self._obs_event("round", frontier=len(self._pending))
                     return
 
     def run_to_completion(self) -> None:
@@ -83,10 +89,15 @@ class OnDemandChecker(HostEngineBase):
     def _run(self) -> None:
         while True:
             with self._lock:
-                for _ in range(BLOCK_SIZE):
-                    if not self._pending:
-                        return
-                    self._process_job(self._pending.pop())
+                with self._metrics.phase("check_block"):
+                    for _ in range(BLOCK_SIZE):
+                        if not self._pending:
+                            self._metrics.inc("waves")
+                            self._obs_event("wave", frontier=0)
+                            return
+                        self._process_job(self._pending.pop())
+                self._metrics.inc("waves")
+                self._obs_event("wave", frontier=len(self._pending))
                 if self._finish_matched(self._discoveries):
                     return
                 if (

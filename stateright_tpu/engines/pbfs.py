@@ -228,6 +228,7 @@ class ParallelBfsChecker(HostEngineBase):
         self._discovery_fps: Dict[str, int] = {}
         self._unique = 0
         self._tables: List[Dict[int, int]] = []
+        self._metrics.set_gauge("workers", self._n)
         self._start()
 
     def _run(self) -> None:
@@ -307,26 +308,34 @@ class ParallelBfsChecker(HostEngineBase):
         try:
             while True:
                 epoch += 1
-                for w in range(n):
-                    ctl_qs[w].put(("poll", epoch))
                 replies = {}
-                deadline = time.monotonic() + 5.0
-                while len(replies) < n and time.monotonic() < deadline:
-                    try:
-                        msg = res_q.get(timeout=0.05)
-                    except queue_mod.Empty:
-                        continue
-                    if msg[0] in ("progress", "final"):
-                        ingest(msg)
-                    elif msg[0] == "poll_reply":
-                        ingest(msg)
-                        if msg[2] == epoch:
-                            replies[msg[1]] = msg
+                with self._metrics.phase("poll"):
+                    for w in range(n):
+                        ctl_qs[w].put(("poll", epoch))
+                    deadline = time.monotonic() + 5.0
+                    while len(replies) < n and time.monotonic() < deadline:
+                        try:
+                            msg = res_q.get(timeout=0.05)
+                        except queue_mod.Empty:
+                            continue
+                        if msg[0] in ("progress", "final"):
+                            ingest(msg)
+                        elif msg[0] == "poll_reply":
+                            ingest(msg)
+                            if msg[2] == epoch:
+                                replies[msg[1]] = msg
 
                 self._state_count = sum(s["sc"] for s in stats.values())
                 self._unique = sum(s["uniq"] for s in stats.values())
                 self._max_depth = max(
                     [s["maxd"] for s in stats.values()] + [self._max_depth]
+                )
+                self._metrics.inc("rounds")
+                self._obs_event(
+                    "round",
+                    frontier=sum(0 if s["idle"] else 1 for s in stats.values()),
+                    workers=n,
+                    epoch=epoch,
                 )
 
                 if self._finish_matched(self._discovery_fps):
@@ -356,7 +365,13 @@ class ParallelBfsChecker(HostEngineBase):
             for w in range(n):
                 ctl_qs[w].put("stop")
             tables: Dict[int, Dict[int, int]] = {}
-            deadline = time.monotonic() + 30
+            # Shard tables cross the result queue as pickled dicts; the
+            # collection deadline must scale with their size or large runs
+            # time out, lose tables, and later raise "fingerprint missing
+            # from shard table" during path reconstruction. Budget ~10µs
+            # per visited entry (generous vs measured pickle+pipe cost) on
+            # top of the old 30s floor.
+            deadline = time.monotonic() + 30 + self._unique * 1e-5
             while len(tables) < n and time.monotonic() < deadline:
                 try:
                     msg = res_q.get(timeout=1.0)

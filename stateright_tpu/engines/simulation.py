@@ -15,6 +15,7 @@ reported counterexample is exactly the random walk that found it.
 from __future__ import annotations
 
 import random
+import threading
 from typing import Any, Dict, List, Optional
 
 from ..checker import CheckerBuilder
@@ -56,6 +57,23 @@ class UniformChooser(Chooser):
         return rng.randrange(len(actions))
 
 
+class _TraceDiscoveries:
+    """A trace-local discovery buffer: membership checks consult the shared
+    map too (so an already-recorded property is skipped), but writes stay
+    local until the owning worker merges them under the counter lock —
+    threaded workers must not mutate the shared dict mid-trace."""
+
+    def __init__(self, shared: Dict[str, List[int]]):
+        self._shared = shared
+        self.local: Dict[str, List[int]] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.local or name in self._shared
+
+    def __setitem__(self, name: str, value: List[int]) -> None:
+        self.local[name] = value
+
+
 class SimulationChecker(HostEngineBase):
     """Reference: SimulationChecker::spawn, simulation.rs:95-211.
 
@@ -75,6 +93,11 @@ class SimulationChecker(HostEngineBase):
         self._seed = seed
         self._chooser = chooser
         self._discoveries: Dict[str, List[int]] = {}  # name -> fingerprint path
+        # Guards _state_count / _max_depth / _discoveries: with .threads(n)
+        # every worker thread merges its per-trace tallies here (unguarded
+        # `+=` read-modify-write races lose counts under free-threading).
+        self._counter_lock = threading.Lock()
+        self._metrics.set_gauge("threads", max(1, self._thread_count))
         self._start()
 
     # -- exploration --------------------------------------------------------
@@ -107,7 +130,9 @@ class SimulationChecker(HostEngineBase):
         )
         thread_rng = random.Random(seed)
         while True:
-            self._check_trace_from_initial(seed)
+            with self._metrics.phase("walk"):
+                self._check_trace_from_initial(seed)
+            self._obs_event("walk", frontier=0, worker=tid)
             if self._finish_matched(self._discoveries):
                 return
             if (
@@ -120,11 +145,17 @@ class SimulationChecker(HostEngineBase):
             seed = thread_rng.getrandbits(64)
 
     def _check_trace_from_initial(self, seed: int) -> None:
-        """One random walk. Mirrors simulation.rs:213-398."""
+        """One random walk. Mirrors simulation.rs:213-398.
+
+        Counters accumulate trace-locally and merge into the shared tallies
+        under `_counter_lock` when the walk ends (per-thread counters summed
+        at trace end — threaded workers would otherwise race the `+=`)."""
         model = self._model
         chooser = self._chooser
         symmetry = self._symmetry
-        discoveries = self._discoveries
+        discoveries = _TraceDiscoveries(self._discoveries)
+        trace_states = 0
+        trace_max_depth = 0
 
         chooser_state = chooser.new_state(seed)
         initial_states = model.init_states()
@@ -138,8 +169,8 @@ class SimulationChecker(HostEngineBase):
         reached_max_depth = False
 
         while True:
-            if len(fingerprint_path) > self._max_depth:
-                self._max_depth = len(fingerprint_path)
+            if len(fingerprint_path) > trace_max_depth:
+                trace_max_depth = len(fingerprint_path)
             if (
                 self._target_max_depth is not None
                 and len(fingerprint_path) >= self._target_max_depth
@@ -157,7 +188,7 @@ class SimulationChecker(HostEngineBase):
             if key in generated:
                 break  # found a loop
             generated.add(key)
-            self._state_count += 1
+            trace_states += 1
 
             if self._visitor is not None:
                 self._visitor.visit(
@@ -192,6 +223,15 @@ class SimulationChecker(HostEngineBase):
             self._terminal_ebit_discoveries(
                 ebits, discoveries, lambda: list(fingerprint_path)
             )
+
+        with self._counter_lock:
+            self._state_count += trace_states
+            if trace_max_depth > self._max_depth:
+                self._max_depth = trace_max_depth
+            for name, fp_path in discoveries.local.items():
+                self._discoveries.setdefault(name, fp_path)
+        self._metrics.inc("traces")
+        self._metrics.inc("states_generated", trace_states)
 
     # -- accessors ----------------------------------------------------------
 

@@ -617,17 +617,13 @@ class TpuBfsChecker(HostEngineBase):
         self._unique = 0
         self._discovery_fps: Dict[str, int] = {}
         self._spill: List[np.ndarray] = []
-        # Telemetry gauges (surfaced via Checker.telemetry / report):
-        # eras dispatched, steps executed, spill/refill row volume, table
-        # growths, final take_cap — the engine's health at a glance.
-        self._telemetry: Dict[str, Any] = {
-            "eras": 0,
-            "steps": 0,
-            "spill_rows": 0,
-            "refill_rows": 0,
-            "table_growths": 0,
-            "take_cap": self._chunk,
-        }
+        # The metrics registry (obs/metrics.py, created by the base class)
+        # carries the engine's health gauges — eras dispatched, steps
+        # executed, spill/refill row volume, table growths, take_cap —
+        # surfaced via Checker.telemetry() / report, plus per-era phase
+        # timers (device_era, readback, spill, refill, table_grow).
+        self._metrics.set_gauge("take_cap", self._chunk)
+        self._era_t0: Optional[float] = None
 
         self._init_ebits_tensor = 0
         e = 0
@@ -698,6 +694,13 @@ class TpuBfsChecker(HostEngineBase):
                     [np.asarray(l, dtype=np.uint32) for l in canon_lanes],
                     axis=1,
                 )
+                # Dedupe representatives host-side: distinct raw inits can
+                # canonicalize to one representative, and while the table's
+                # claim insert would keep exactly one, every duplicate ROW
+                # would still enqueue (a redundant re-expansion each) and
+                # count toward state_count — ring contents and counters
+                # must agree with the table's view under symmetry.
+                inits = np.unique(inits, axis=0)
             n_init = len(inits)
             self._state_count = n_init
             if n_init == 0:
@@ -744,6 +747,7 @@ class TpuBfsChecker(HostEngineBase):
             seed_run = _build_seed_loop(
                 tm, self._tprops, C, self._qcap, self._tcap, self._canon
             )
+            self._era_t0 = time.monotonic()
             table, queue, rec_fp1, rec_fp2, params_dev = seed_run(
                 jnp.asarray(qinit), jnp.asarray(h1), jnp.asarray(h2),
                 jnp.asarray(template), rec_fp1, rec_fp2,
@@ -768,7 +772,16 @@ class TpuBfsChecker(HostEngineBase):
             a loop dispatch): counters, discoveries, spill, checkpoints,
             and stop conditions."""
             nonlocal head, count, take_cap, rec_bits, stop, params_dev
-            vals = np.asarray(params_dev)  # the ONE download per block
+            with self._metrics.phase("readback"):
+                vals = np.asarray(params_dev)  # the ONE download per block
+            if self._era_t0 is not None:
+                # The era's true wall time: dispatch through readback
+                # complete (dispatch alone returns immediately — JAX is
+                # async on this platform).
+                self._metrics.add_phase(
+                    "device_era", time.monotonic() - self._era_t0
+                )
+                self._era_t0 = None
             _dbg(
                 f"era result steps={vals[10]} gen={vals[8]} count={vals[1]} "
                 f"unique={vals[2]} rec={vals[3]:b}"
@@ -780,7 +793,7 @@ class TpuBfsChecker(HostEngineBase):
                 # ZERO steps on the first era means the unresolved count
                 # flowed in from the seeder (init-state insert), not the
                 # era loop — attribute it correctly.
-                if self._telemetry["eras"] == 0 and int(vals[10]) == 0:
+                if self._metrics.get("eras") == 0 and int(vals[10]) == 0:
                     raise RuntimeError(
                         "init-state seeding exhausted the visited-table "
                         "probe budget (duplicate-heavy or adversarial "
@@ -792,9 +805,10 @@ class TpuBfsChecker(HostEngineBase):
             head = int(vals[0])
             count = int(vals[1])
             take_cap = int(vals[P_TAKE_CAP])
-            self._telemetry["eras"] += 1
-            self._telemetry["steps"] += int(vals[10])
-            self._telemetry["take_cap"] = take_cap
+            self._metrics.inc("eras")
+            self._metrics.inc("steps", int(vals[10]))
+            self._metrics.inc("states_generated", int(vals[8]))
+            self._metrics.set_gauge("take_cap", take_cap)
             self._unique = int(vals[2])
             self._state_count += int(vals[8])
             self._max_depth = max(self._max_depth, int(vals[9]))
@@ -817,6 +831,7 @@ class TpuBfsChecker(HostEngineBase):
             # steps, thrashing spill round-trips (measured on ABD c=4:
             # 2-3 useful steps per ~7s spill cycle). The margin trades one
             # bigger drain for eras long enough to amortize it.
+            spilled = 0
             if count > high_water:
                 k = count - spill_target
                 take_idx = jnp.asarray(
@@ -824,14 +839,18 @@ class TpuBfsChecker(HostEngineBase):
                 )
                 # Stack on device, download ONCE (per-lane downloads cost a
                 # ~100ms round-trip each on this platform).
-                big = np.asarray(
-                    jnp.stack([queue[i][take_idx] for i in range(W)], axis=1)
-                )
+                with self._metrics.phase("spill"):
+                    big = np.asarray(
+                        jnp.stack(
+                            [queue[i][take_idx] for i in range(W)], axis=1
+                        )
+                    )
                 # Keep blocks refill-sized so partial refills stay possible.
                 for off in range(0, k, C * A):
                     self._spill.append(big[off : off + C * A])
                 count -= k
-                self._telemetry["spill_rows"] += k
+                spilled = k
+                self._metrics.inc("spill_rows", k)
                 # Refills can place these rows after deeper children, breaking
                 # the ring's depth monotonicity that the block-level maxd read
                 # relies on — fold their depth in here. (Counts rows that are
@@ -839,6 +858,16 @@ class TpuBfsChecker(HostEngineBase):
                 # slight over-report beats a systematic under-report.)
                 self._max_depth = max(self._max_depth, int(big[:, S + 1].max()))
                 params_dev = None  # host-side count changed; force re-upload
+
+            self._obs_event(
+                "era",
+                frontier=count,
+                load_factor=round(self._unique / self._tcap, 4),
+                take_cap=take_cap,
+                steps=int(vals[10]),
+                generated=int(vals[8]),
+                spill_rows=spilled,
+            )
 
             if self._ckpt_path is not None and (
                 self._ckpt_every is not None
@@ -884,13 +913,14 @@ class TpuBfsChecker(HostEngineBase):
                 tail_idx = jnp.asarray(
                     (head + count + np.arange(k)) & (self._qcap - 1)
                 )
-                rows_dev = jnp.asarray(rows)  # ONE upload for all blocks
-                queue = tuple(
-                    queue[i].at[tail_idx].set(rows_dev[:, i])
-                    for i in range(W)
-                )
+                with self._metrics.phase("refill"):
+                    rows_dev = jnp.asarray(rows)  # ONE upload for all blocks
+                    queue = tuple(
+                        queue[i].at[tail_idx].set(rows_dev[:, i])
+                        for i in range(W)
+                    )
                 count += k
-                self._telemetry["refill_rows"] += k
+                self._metrics.inc("refill_rows", k)
                 host_dirty = True
             if count == 0:
                 break
@@ -900,8 +930,9 @@ class TpuBfsChecker(HostEngineBase):
             # exhausted (exhaustion would silently drop states).
             vcap = _vcap(A, C)
             while self._unique + vcap > vs.MAX_LOAD * self._tcap:
-                table, self._tcap = self._grow_table(table)
-                self._telemetry["table_growths"] += 1
+                with self._metrics.phase("table_grow"):
+                    table, self._tcap = self._grow_table(table)
+                self._metrics.inc("table_growths")
                 host_dirty = True
             grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - vcap)
 
@@ -940,6 +971,7 @@ class TpuBfsChecker(HostEngineBase):
             last_max_steps = max_steps
 
             _t0 = time.monotonic()
+            self._era_t0 = _t0
             table, queue, rec_fp1, rec_fp2, params_dev = self._loop(
                 table, queue, rec_fp1, rec_fp2, params_in
             )
@@ -1066,11 +1098,11 @@ class TpuBfsChecker(HostEngineBase):
     # -- accessors ----------------------------------------------------------
 
     def telemetry(self) -> Dict[str, Any]:
-        t = dict(self._telemetry)
-        t["table_capacity"] = self._tcap
-        t["load_factor"] = round(self._unique / self._tcap, 4)
-        t["chunk"] = self._chunk
-        return t
+        m = self._metrics
+        m.set_gauge("table_capacity", self._tcap)
+        m.set_gauge("load_factor", round(self._unique / self._tcap, 4))
+        m.set_gauge("chunk", self._chunk)
+        return super().telemetry()
 
     def unique_state_count(self) -> int:
         return self._unique

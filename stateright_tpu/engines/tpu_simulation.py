@@ -23,7 +23,12 @@ Design notes (TPU-first, not a translation):
     per-trace discipline (simulation.rs:154-197).
   - Ended walks (terminal / cycle / depth-cap) restart IN PLACE with an
     evolved seed; a walk that records a discovery freezes until the era
-    ends so its path buffer survives for extraction.
+    ends so its path buffer survives for extraction. The frozen flag is a
+    walk lane that CROSSES the era boundary: the host harvests discovery
+    paths between dispatches, and the next era restarts frozen walks
+    (fresh init, evolved seed, cleared path row) — resuming them mid-walk
+    would make each see its own recorded path as a cycle and fabricate
+    EVENTUALLY counterexamples.
 
 Semantic divergences from the host engine (documented, both benign for
 the engine's purpose of finding examples/counterexamples fast):
@@ -115,8 +120,16 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def loop(walk, fp1buf, fp2buf, params):
-        """walk = (rows[S], seed, ptr, ebits) lanes of [B];
-        fp*buf = [B * L] flat path buffers."""
+        """walk = (rows[S], seed, ptr, ebits, frozen) lanes of [B];
+        fp*buf = [B * L] flat path buffers. The frozen lane MUST cross the
+        era boundary: a walk freezes when it records a discovery and its
+        current state is already in its own path buffer, so silently
+        thawing it mid-walk (the pre-fix behavior) made its first
+        membership test see itself — a fake cycle, which with surviving
+        eventually-bits fabricated spurious EVENTUALLY counterexamples.
+        The host harvests discovery paths between dispatches, so frozen
+        arrivals RESTART here (fresh init, evolved seed, cleared path row)
+        instead of resuming — sound, and generation never starves."""
         u = jnp.uint32
         rec_bits0 = params[P_REC]
         max_steps = params[P_MAX_STEPS]
@@ -277,6 +290,19 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             )
 
         rows, seed, ptr, ebits = walk[:S], walk[S], walk[S + 1], walk[S + 2]
+        # Era prologue: restart walks that arrived frozen (see docstring).
+        frozen_in = walk[S + 3] != u(0)
+        fseed = prng(seed + u(0x6A09E667))
+        fpick = prng(fseed) % u(n_init)
+        rows = tuple(
+            jnp.where(frozen_in, inits[s][fpick], rows[s]) for s in range(S)
+        )
+        seed = jnp.where(frozen_in, fseed, seed)
+        ebits = jnp.where(frozen_in, u(init_ebits), ebits)
+        ptr = jnp.where(frozen_in, u(0), ptr)
+        keep = ~frozen_in
+        fp1buf = (fp1buf.reshape(B, L) * keep[:, None]).reshape(-1)
+        fp2buf = (fp2buf.reshape(B, L) * keep[:, None]).reshape(-1)
         zero_b = seed & u(0)
         false_b = zero_b != 0
         init_carry = (
@@ -315,7 +341,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             disc_plen = disc_plen.at[i].set(plen[i][sel])
             rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
 
-        walk_out = tuple(rows) + (seed, ptr, ebits)
+        walk_out = tuple(rows) + (seed, ptr, ebits, frozen.astype(u))
         # Discovery walk indices and path lengths ride the params tail so
         # the era result is ONE download (each separate device read costs
         # ~100ms here — the simulation TTFC floor).
@@ -360,6 +386,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             seeds,
             jnp.zeros(B, dtype=u),
             jnp.full(B, init_ebits, dtype=u),
+            jnp.zeros(B, dtype=u),  # frozen lane: nothing frozen yet
         )
         fp1buf = jnp.zeros(B * L, dtype=u)
         fp2buf = jnp.zeros(B * L, dtype=u)
@@ -412,7 +439,8 @@ class TpuSimulationChecker(HostEngineBase):
         )
         self._sync = sync_steps
         self._discovery_paths: Dict[str, List[int]] = {}
-        self._telemetry: Dict[str, Any] = {"eras": 0, "steps": 0, "restid": 0}
+        self._metrics.set_gauge("walks", self._B)
+        self._metrics.set_gauge("walk_cap", self._L)
         self._loop, self._seed_run, self._n_init = _build_sim_loop(
             self.tm, self._tprops, self._B, self._L
         )
@@ -457,6 +485,7 @@ class TpuSimulationChecker(HostEngineBase):
         params_dev = jnp.asarray(params)
 
         while True:
+            era_t0 = time.monotonic()
             if first:
                 walk, fp1buf, fp2buf, params_dev = self._seed_run(params_dev)
                 first = False
@@ -464,10 +493,14 @@ class TpuSimulationChecker(HostEngineBase):
                 walk, fp1buf, fp2buf, params_dev = self._loop(
                     walk, fp1buf, fp2buf, params_dev
                 )
-            vals = np.asarray(params_dev)
-            self._telemetry["eras"] += 1
-            self._telemetry["steps"] += int(vals[P_STEPS])
+            with self._metrics.phase("readback"):
+                vals = np.asarray(params_dev)
+            self._metrics.add_phase("device_era", time.monotonic() - era_t0)
+            self._metrics.inc("eras")
+            self._metrics.inc("steps", int(vals[P_STEPS]))
+            gen_prev = gen_total
             gen_total = int(vals[P_GEN])
+            self._metrics.inc("states_generated", gen_total - gen_prev)
             self._state_count = gen_total
             self._max_depth = max(self._max_depth, int(vals[P_MAXD]))
 
@@ -493,6 +526,12 @@ class TpuSimulationChecker(HostEngineBase):
                     self._discovery_paths[p.name] = chain
                 rec_bits = new_bits
 
+            self._obs_event(
+                "era",
+                frontier=self._B,
+                steps=int(vals[P_STEPS]),
+                generated=gen_total - gen_prev,
+            )
             if self._finish_matched(self._discovery_paths):
                 return
             if target_gen and gen_total >= target_gen:
@@ -501,12 +540,6 @@ class TpuSimulationChecker(HostEngineBase):
                 return
 
     # -- accessors ----------------------------------------------------------
-
-    def telemetry(self) -> Dict[str, Any]:
-        t = dict(self._telemetry)
-        t["walks"] = self._B
-        t["walk_cap"] = self._L
-        return t
 
     def unique_state_count(self) -> int:
         # Like the host simulation engine: no global visited set is kept

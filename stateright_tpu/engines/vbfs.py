@@ -93,6 +93,7 @@ class VectorizedBfsChecker(HostEngineBase):
         is_new = self._visited.insert_batch(keys, self._nthreads)
         for k in keys[is_new]:
             self._parents[int(k)] = 0
+        self._metrics.set_gauge("threads", self._nthreads)
         self._blocks = deque()
         if len(inits):
             self._blocks.append(
@@ -140,19 +141,21 @@ class VectorizedBfsChecker(HostEngineBase):
             # Property evaluation (ops/expand.py parity).
             ebits = ebits.copy()
             prop_hits = []
-            for i, p in enumerate(self._tprops):
-                if p.expectation == Expectation.EVENTUALLY:
-                    vals = np.asarray(p.check(np, lanes), dtype=bool) & live
-                    ebits[vals] &= ~np.uint32(1 << self._e_slot[i])
-                    prop_hits.append(None)
-                    continue
-                cond = np.asarray(p.check(np, lanes), dtype=bool)
-                if p.expectation == Expectation.ALWAYS:
-                    prop_hits.append(live & ~cond)
-                else:
-                    prop_hits.append(live & cond)
+            with self._metrics.phase("property_eval"):
+                for i, p in enumerate(self._tprops):
+                    if p.expectation == Expectation.EVENTUALLY:
+                        vals = np.asarray(p.check(np, lanes), dtype=bool) & live
+                        ebits[vals] &= ~np.uint32(1 << self._e_slot[i])
+                        prop_hits.append(None)
+                        continue
+                    cond = np.asarray(p.check(np, lanes), dtype=bool)
+                    if p.expectation == Expectation.ALWAYS:
+                        prop_hits.append(live & ~cond)
+                    else:
+                        prop_hits.append(live & cond)
 
-            succs, amask = tm.step_lanes(np, lanes)
+            with self._metrics.phase("expand"):
+                succs, amask = tm.step_lanes(np, lanes)
             any_valid = np.zeros(B, dtype=bool)
             cand_rows = []
             cand_parent = []
@@ -199,11 +202,13 @@ class VectorizedBfsChecker(HostEngineBase):
                 cparent = np.concatenate(cand_parent)
                 cebits = np.concatenate(cand_ebits)
                 cdepth = np.concatenate(cand_depth)
-                h1, h2 = hash_words_np(crows)
+                with self._metrics.phase("hash"):
+                    h1, h2 = hash_words_np(crows)
                 ckeys = (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(
                     np.uint64
                 )
-                is_new = self._visited.insert_batch(ckeys, self._nthreads)
+                with self._metrics.phase("visited_insert"):
+                    is_new = self._visited.insert_batch(ckeys, self._nthreads)
                 if is_new.any():
                     nidx = np.flatnonzero(is_new)
                     nk = ckeys[nidx]
@@ -220,6 +225,12 @@ class VectorizedBfsChecker(HostEngineBase):
                         )
                     )
 
+            self._metrics.inc("waves")
+            self._obs_event(
+                "wave",
+                frontier=sum(len(b[0]) for b in self._blocks),
+                block_rows=B,
+            )
             if self._finish_matched(self._discovery_fps):
                 return
             if (

@@ -5,6 +5,10 @@ Reference parity: src/checker/explorer.rs. Routes:
   - ``GET /``, ``/app.css``, ``/app.js`` — the bundled single-page UI;
   - ``GET /.status`` — checker progress + per-property discovery paths
     (StatusView, explorer.rs:15-24);
+  - ``GET /metrics`` (alias ``/.metrics``) — live JSON telemetry snapshot
+    (counters + the engine's metrics registry, obs/metrics.py) feeding the
+    dashboard panel's states/sec sparkline and gauges — beyond the
+    reference, which has no runtime observability surface;
   - ``GET /.states/{fp}/{fp}/...`` — walk the state space by fingerprint
     path: returns the successor `StateView`s of the path's final state,
     asking the on-demand checker to expand that frontier node in the
@@ -79,6 +83,20 @@ def _status_view(checker: Checker, model: Model, snapshot: _Snapshot) -> Dict:
         "max_depth": checker.max_depth(),
         "properties": _properties_view(checker, model),
         "recent_path": snapshot.recent(),
+    }
+
+
+def _metrics_view(checker: Checker) -> Dict:
+    """GET /metrics: one timestamped snapshot of the run's counters plus the
+    engine's metrics registry (obs/metrics.py). The dashboard polls this to
+    derive the states/sec sparkline client-side from successive samples."""
+    return {
+        "ts": time.time(),
+        "done": checker.is_done(),
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "telemetry": checker.telemetry(),
     }
 
 
@@ -213,6 +231,8 @@ class ExplorerServer:
                     self._send_json(
                         _status_view(explorer.checker, explorer.model, explorer.snapshot)
                     )
+                elif path in ("/metrics", "/.metrics"):
+                    self._send_json(_metrics_view(explorer.checker))
                 elif path.startswith("/.states"):
                     try:
                         self._send_json(

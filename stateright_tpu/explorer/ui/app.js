@@ -58,6 +58,112 @@ async function pollStatus() {
   setTimeout(pollStatus, 1000);
 }
 
+// ---- live metrics dashboard ------------------------------------------------
+// Polls /metrics once a second; the states/sec series derives client-side
+// from successive state_count samples. Single series, one hue (--series-1),
+// hover shows the nearest sample's value.
+
+const sparkHistory = []; // [{ts, rate}], bounded window
+const SPARK_WINDOW = 60;
+let lastMetricsSample = null;
+
+function fmtRate(r) {
+  if (r >= 1e6) return (r / 1e6).toFixed(2) + "M";
+  if (r >= 1e3) return (r / 1e3).toFixed(1) + "k";
+  return r.toFixed(0);
+}
+
+function renderSparkline(hoverX) {
+  const svg = $("sparkline");
+  const w = svg.clientWidth || 240;
+  const h = svg.clientHeight || 36;
+  const pad = 2;
+  svg.innerHTML = "";
+  if (sparkHistory.length < 2) return;
+  const max = Math.max(...sparkHistory.map((s) => s.rate), 1);
+  const dx = (w - 2 * pad) / (SPARK_WINDOW - 1);
+  const x0 = w - pad - (sparkHistory.length - 1) * dx;
+  const pts = sparkHistory.map((s, i) => [
+    x0 + i * dx,
+    h - pad - (s.rate / max) * (h - 2 * pad),
+  ]);
+  const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+  line.setAttribute("points", pts.map((p) => p.map((v) => v.toFixed(1)).join(",")).join(" "));
+  line.setAttribute("class", "spark-line");
+  svg.appendChild(line);
+  // Hover readout: nearest sample to the cursor gets a marker + value.
+  let idx = sparkHistory.length - 1;
+  if (hoverX != null) {
+    idx = Math.max(0, Math.min(sparkHistory.length - 1, Math.round((hoverX - x0) / dx)));
+    const dot = document.createElementNS("http://www.w3.org/2000/svg", "circle");
+    dot.setAttribute("cx", pts[idx][0].toFixed(1));
+    dot.setAttribute("cy", pts[idx][1].toFixed(1));
+    dot.setAttribute("r", "3");
+    dot.setAttribute("class", "spark-dot");
+    svg.appendChild(dot);
+  }
+  $("spark-readout").textContent =
+    hoverX != null ? fmtRate(sparkHistory[idx].rate) + "/s" : "";
+}
+
+function renderGauges(m) {
+  const box = $("gauges");
+  box.innerHTML = "";
+  const add = (k, v) => {
+    const row = document.createElement("div");
+    row.className = "gauge";
+    const key = document.createElement("span");
+    key.className = "gauge-k";
+    key.textContent = k;
+    const val = document.createElement("span");
+    val.className = "gauge-v";
+    val.textContent = v;
+    row.appendChild(key);
+    row.appendChild(val);
+    box.appendChild(row);
+  };
+  add("states", m.state_count.toLocaleString());
+  add("unique", m.unique_state_count.toLocaleString());
+  add("depth", m.max_depth);
+  const tel = m.telemetry || {};
+  for (const k of Object.keys(tel).sort()) {
+    if (k === "phase_ms" || k === "engine") continue;
+    add(k, typeof tel[k] === "number" ? tel[k].toLocaleString() : tel[k]);
+  }
+  const phases = tel.phase_ms || {};
+  for (const k of Object.keys(phases).sort()) {
+    add(k + " ms", phases[k].toLocaleString());
+  }
+}
+
+async function pollMetrics() {
+  try {
+    const res = await fetch("/metrics");
+    const m = await res.json();
+    $("metrics-panel").hidden = false;
+    if (lastMetricsSample && m.ts > lastMetricsSample.ts) {
+      const rate =
+        (m.state_count - lastMetricsSample.state_count) /
+        (m.ts - lastMetricsSample.ts);
+      sparkHistory.push({ ts: m.ts, rate: Math.max(0, rate) });
+      if (sparkHistory.length > SPARK_WINDOW) sparkHistory.shift();
+      $("rate-now").textContent = fmtRate(Math.max(0, rate));
+    }
+    lastMetricsSample = m;
+    renderSparkline(null);
+    renderGauges(m);
+  } catch (e) {
+    /* metrics endpoint unavailable: leave the panel hidden */
+  }
+  setTimeout(pollMetrics, 1000);
+}
+
+$("sparkline").addEventListener("mousemove", (ev) => {
+  const box = $("sparkline").getBoundingClientRect();
+  renderSparkline(ev.clientX - box.left);
+});
+$("sparkline").addEventListener("mouseleave", () => renderSparkline(null));
+
 function renderBreadcrumbs() {
   const nav = $("breadcrumbs");
   nav.innerHTML = "";
@@ -157,4 +263,5 @@ $("run-to-completion").addEventListener("click", async () => {
 
 window.addEventListener("hashchange", loadStates);
 pollStatus();
+pollMetrics();
 loadStates();

@@ -129,6 +129,12 @@ def net_step_ordered(xp, net, slot_id, sends):
             cur[m + 1] = hi
     # Insert sends at their flow tails (rank = current flow depth).
     for v in sends:
+        # Handlers must emit rank-less envelopes (payloads limited to the
+        # ORDERED_PAY_MASK 16 bits); mask the rank nibble regardless, so a
+        # handler payload that strays into bits 16-19 cannot pre-load a
+        # bogus rank and corrupt per-flow FIFO ordering when the real rank
+        # is OR'd in below.
+        v = v & ~u(RANK_FIELD)
         has = v != u(0)
         vflow = _flow_id(xp, v)
         depth = u(0) * v

@@ -1,0 +1,18 @@
+"""Unified engine observability: metrics registry + structured run traces.
+
+Every engine owns a `MetricsRegistry` (created by `HostEngineBase`) and
+populates it through one common API — counters, gauges, and monotonic phase
+timers — which backs `Checker.telemetry()` uniformly across all nine
+engines. `CheckerBuilder.trace(path)` additionally streams one JSONL event
+per era/wave/round to disk via `TraceWriter`, and
+`CheckerBuilder.profile(dir)` brackets the run with `jax.profiler` traces
+when the profiler is available.
+
+See `obs/metrics.py` for the metric-name catalog and `obs/trace.py` for the
+trace event schema.
+"""
+
+from .metrics import MetricsRegistry
+from .trace import TraceWriter, start_profile, stop_profile
+
+__all__ = ["MetricsRegistry", "TraceWriter", "start_profile", "stop_profile"]

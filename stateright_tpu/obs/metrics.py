@@ -1,0 +1,153 @@
+"""The metrics registry: counters, gauges, and monotonic phase timers.
+
+One `MetricsRegistry` per checker run (created in `HostEngineBase.__init__`)
+backs `Checker.telemetry()` for every engine, replacing the old
+`tpu_bfs`-only `_telemetry` dict. The API is deliberately tiny — engines hot
+loops must stay hot — and every method is thread-safe (host engines mutate
+from worker threads while `Checker.report()` polls from the caller's).
+
+Metric-name catalog
+===================
+
+Counters (`inc`) — monotonic totals:
+
+  =====================  =====================================================
+  name                   meaning
+  =====================  =====================================================
+  ``eras``               device dispatch+readback round-trips (device engines)
+  ``waves``              host frontier blocks processed (bfs/dfs/vbfs/on_demand)
+  ``rounds``             coordinator polling epochs (pbfs)
+  ``traces``             completed random walks (simulation engines)
+  ``steps``              device loop iterations actually executed
+  ``states_generated``   successor states generated (incl. duplicates)
+  ``spill_rows``         frontier rows spilled device -> host
+  ``refill_rows``        frontier rows refilled host -> device
+  ``table_growths``      visited-table doublings (grow + rehash)
+  ``expand_requests``    on-demand fingerprint expansions served
+  =====================  =====================================================
+
+Gauges (`set_gauge`) — last-observed values:
+
+  =======================  ===================================================
+  name                     meaning
+  =======================  ===================================================
+  ``frontier_size``        pending rows/jobs after the last era/wave
+  ``max_depth``            deepest state visited so far
+  ``take_cap``             device engines' self-tuned pop width
+  ``load_factor``          visited-table occupancy / capacity
+  ``table_capacity``       visited-table capacity (per shard when sharded)
+  ``chunk``                device engines' data-parallel chunk width
+  ``walks`` / ``walk_cap`` simulation batch width / path-buffer depth
+  ``threads`` / ``workers``  host parallelism actually used
+  ``n_shards`` / ``quota``   mesh engine shard count / exchange quota
+  =======================  ===================================================
+
+Phase timers (`phase(name)` context manager / `add_phase`) — cumulative
+wall milliseconds per hot-path phase, surfaced as the nested ``phase_ms``
+dict in `snapshot()`:
+
+  =====================  =====================================================
+  phase                  measures
+  =====================  =====================================================
+  ``device_era``         one era: dispatch through params readback complete
+  ``readback``           device -> host stats/result downloads
+  ``upload``             host -> device parameter/frontier uploads
+  ``spill``              frontier spill downloads (device -> host)
+  ``refill``             frontier refill uploads (host -> device)
+  ``table_grow``         visited-table grow + rehash
+  ``check_block``        one host BFS/DFS/on-demand block (pop..expand)
+  ``property_eval``      batched property evaluation (vbfs)
+  ``expand``             batched successor generation (vbfs)
+  ``hash``               batched fingerprinting (vbfs)
+  ``visited_insert``     visited-set probe + insert (vbfs native set)
+  ``walk``               one host simulation trace end-to-end
+  ``poll``               one pbfs coordinator polling epoch
+  =====================  =====================================================
+
+Engines only populate the rows that exist on their architecture; absent
+phases simply never appear in the snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+
+class _PhaseTimer:
+    """Context manager accumulating wall time into one phase bucket."""
+
+    __slots__ = ("_registry", "_name", "_t0")
+
+    def __init__(self, registry: "MetricsRegistry", name: str):
+        self._registry = registry
+        self._name = name
+
+    def __enter__(self) -> "_PhaseTimer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._registry.add_phase(self._name, time.monotonic() - self._t0)
+
+
+class MetricsRegistry:
+    """Thread-safe counters + gauges + phase timers for one checker run."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._phase_secs: Dict[str, float] = {}
+        self._phase_calls: Dict[str, int] = {}
+
+    # -- counters ------------------------------------------------------------
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(delta)
+
+    def get(self, name: str, default: int = 0) -> int:
+        with self._lock:
+            return self._counters.get(name, default)
+
+    # -- gauges --------------------------------------------------------------
+
+    def set_gauge(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    # -- phase timers --------------------------------------------------------
+
+    def phase(self, name: str) -> _PhaseTimer:
+        """`with registry.phase("device_era"): ...` accumulates wall time."""
+        return _PhaseTimer(self, name)
+
+    def add_phase(self, name: str, secs: float) -> None:
+        with self._lock:
+            self._phase_secs[name] = self._phase_secs.get(name, 0.0) + secs
+            self._phase_calls[name] = self._phase_calls.get(name, 0) + 1
+
+    def phase_ms(self) -> Dict[str, float]:
+        """Cumulative milliseconds per phase (sorted by name)."""
+        with self._lock:
+            return {
+                k: round(v * 1000.0, 3)
+                for k, v in sorted(self._phase_secs.items())
+            }
+
+    # -- export --------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Flat counters + gauges, plus nested ``phase_ms`` when any phase
+        has been timed. This is what `Checker.telemetry()` returns."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            out.update(self._gauges)
+            if self._phase_secs:
+                out["phase_ms"] = {
+                    k: round(v * 1000.0, 3)
+                    for k, v in sorted(self._phase_secs.items())
+                }
+        return out

@@ -1,0 +1,116 @@
+"""Structured run traces: one JSONL event per era/wave/round.
+
+`CheckerBuilder.trace(path)` hands every engine a `TraceWriter`; the engine
+emits one event per unit of forward progress (an *era* for the device
+engines, a *wave*/block for the host engines, a *round* for the pbfs
+coordinator, a *walk* for simulation traces) plus `run_start` / `run_end`
+brackets. Lines are standalone JSON objects, flushed as written, so a
+killed run still leaves a parseable prefix.
+
+Event schema — every record carries:
+
+  ``ts``      wall-clock seconds (time.time())
+  ``seq``     per-writer monotonically increasing sequence number
+  ``engine``  emitting engine class name
+  ``event``   "run_start" | "era" | "wave" | "round" | "walk" | "run_end"
+
+Progress events additionally carry ``states`` (generated total),
+``unique`` (unique states so far), ``frontier`` (pending rows/jobs),
+``max_depth``, and ``phase_ms`` — the per-event *delta* of each phase
+timer, i.e. the milliseconds each instrumented phase consumed since the
+previous event (see obs/metrics.py for the phase catalog). Device-engine
+era events also carry ``load_factor``, ``take_cap``, ``steps``,
+``generated``, and ``spill_rows`` for that era.
+
+Profiling: `start_profile(dir)` / `stop_profile()` wrap `jax.profiler`
+start/stop_trace and degrade to no-ops when the profiler (or jax itself)
+is unavailable, so `CheckerBuilder.profile(dir)` is safe on any backend.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+
+def _coerce(obj: Any):
+    """JSON fallback for numpy scalars and other non-JSON types."""
+    try:
+        return int(obj)
+    except (TypeError, ValueError):
+        try:
+            return float(obj)
+        except (TypeError, ValueError):
+            return repr(obj)
+
+
+class TraceWriter:
+    """Append-only JSONL event stream for one checking run. Thread-safe;
+    every emit is one flushed line, so traces survive hard kills."""
+
+    def __init__(self, path: str, engine: str = ""):
+        self._path = path
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._f = open(path, "w", encoding="utf-8")
+
+    def emit(self, event: str, **fields: Any) -> None:
+        record = {
+            "ts": time.time(),
+            "seq": 0,
+            "engine": self._engine,
+            "event": event,
+        }
+        record.update(fields)
+        with self._lock:
+            if self._f.closed:
+                return
+            record["seq"] = self._seq
+            self._seq += 1
+            self._f.write(json.dumps(record, default=_coerce) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+# -- jax.profiler bracket (best-effort; no-op off-device) ---------------------
+
+_profile_active = False
+_profile_lock = threading.Lock()
+
+
+def start_profile(log_dir: str) -> bool:
+    """Start a jax.profiler trace into `log_dir`. Returns False (and does
+    nothing) when the profiler is unavailable or already running."""
+    global _profile_active
+    with _profile_lock:
+        if _profile_active:
+            return False
+        try:
+            import jax.profiler
+
+            jax.profiler.start_trace(log_dir)
+        except Exception:
+            return False
+        _profile_active = True
+        return True
+
+
+def stop_profile() -> None:
+    global _profile_active
+    with _profile_lock:
+        if not _profile_active:
+            return
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _profile_active = False

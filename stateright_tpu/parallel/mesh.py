@@ -701,12 +701,14 @@ class ShardedBfsChecker(HostEngineBase):
                     idx = jnp.asarray(
                         (heads[s] + counts[s] + np.arange(k)) & (self._qcap - 1)
                     )
-                    rows_dev = jnp.asarray(rows)
-                    queue = tuple(
-                        queue[t].at[s, idx].set(rows_dev[:, t])
-                        for t in range(W)
-                    )
+                    with self._metrics.phase("refill"):
+                        rows_dev = jnp.asarray(rows)
+                        queue = tuple(
+                            queue[t].at[s, idx].set(rows_dev[:, t])
+                            for t in range(W)
+                        )
                     counts[s] += k
+                    self._metrics.inc("refill_rows", k)
             if counts.sum() == 0:
                 if any(self._spill[s] for s in range(N)):
                     # Unreachable by the block-size invariant above; loud
@@ -720,7 +722,9 @@ class ShardedBfsChecker(HostEngineBase):
                 max(per_shard_unique) + N * self._quota
                 > vs.MAX_LOAD * self._tcap
             ):
-                table = self._grow_tables(table)
+                with self._metrics.phase("table_grow"):
+                    table = self._grow_tables(table)
+                self._metrics.inc("table_growths")
             grow_limit = max(
                 0, int(vs.MAX_LOAD * self._tcap) - N * self._quota
             )
@@ -742,10 +746,14 @@ class ShardedBfsChecker(HostEngineBase):
                     0, 0, 0, 0, take_caps[s],
                     fin_any, fin_all, fin_all_en,
                 ]
-            table, queue, rec_fp1, rec_fp2, params, disc_depth = self._block(
-                table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
-            )
-            vals = np.asarray(params)  # the one download per block
+            with self._metrics.phase("device_era"):
+                table, queue, rec_fp1, rec_fp2, params, disc_depth = (
+                    self._block(
+                        table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
+                    )
+                )
+                with self._metrics.phase("readback"):
+                    vals = np.asarray(params)  # the one download per block
 
             if vals[:, P_ERR].any():
                 raise RuntimeError(
@@ -758,6 +766,10 @@ class ShardedBfsChecker(HostEngineBase):
             self._unique = int(sum(per_shard_unique))
             self._state_count += int(vals[:, P_GEN].sum())
             self._max_depth = max(self._max_depth, int(vals[:, P_MAXD].max()))
+            self._metrics.inc("eras")
+            self._metrics.inc("steps", int(vals[:, P_STEPS].sum()))
+            self._metrics.inc("states_generated", int(vals[:, P_GEN].sum()))
+            self._metrics.set_gauge("take_cap", int(min(take_caps)))
 
             block_bits = int(np.bitwise_or.reduce(vals[:, P_REC]))
             if block_bits:
@@ -781,6 +793,7 @@ class ShardedBfsChecker(HostEngineBase):
 
             # Per-shard spill: drain to the hysteresis margin, ONE stacked
             # download per shard.
+            spilled = 0
             for s in range(N):
                 if counts[s] > high_water:
                     k = int(counts[s] - spill_target)
@@ -788,17 +801,32 @@ class ShardedBfsChecker(HostEngineBase):
                         (heads[s] + counts[s] - k + np.arange(k))
                         & (self._qcap - 1)
                     )
-                    big = np.asarray(
-                        jnp.stack(
-                            [queue[t][s, idx] for t in range(W)], axis=1
+                    with self._metrics.phase("spill"):
+                        big = np.asarray(
+                            jnp.stack(
+                                [queue[t][s, idx] for t in range(W)], axis=1
+                            )
                         )
-                    )
                     for off in range(0, k, N * self._quota):
                         self._spill[s].append(big[off : off + N * self._quota])
                     counts[s] -= k
+                    spilled += k
+                    self._metrics.inc("spill_rows", k)
                     self._max_depth = max(
                         self._max_depth, int(big[:, S + 1].max())
                     )
+
+            self._obs_event(
+                "era",
+                frontier=int(counts.sum()),
+                load_factor=round(
+                    max(per_shard_unique) / max(1, self._tcap), 4
+                ),
+                take_cap=int(min(take_caps)),
+                steps=int(vals[:, P_STEPS].sum()),
+                generated=int(vals[:, P_GEN].sum()),
+                spill_rows=spilled,
+            )
 
             if self._ckpt_path is not None and (
                 self._ckpt_every is not None
@@ -967,6 +995,18 @@ class ShardedBfsChecker(HostEngineBase):
         return table
 
     # -- accessors ----------------------------------------------------------
+
+    def telemetry(self) -> Dict[str, Any]:
+        m = self._metrics
+        m.set_gauge("n_shards", self.n_shards)
+        m.set_gauge("quota", self._quota)
+        m.set_gauge("chunk", self._chunk)
+        m.set_gauge("table_capacity", self._tcap)
+        m.set_gauge(
+            "load_factor",
+            round(self._unique / max(1, self.n_shards * self._tcap), 4),
+        )
+        return super().telemetry()
 
     def unique_state_count(self) -> int:
         return self._unique

@@ -2,13 +2,20 @@
 
 Reference: src/report.rs. `WriteReporter` prints the same line formats the
 reference's bench harness greps ("Done. states=… unique=… depth=… sec=…",
-report.rs:66-74).
+report.rs:66-74), augmented with registry-derived rate information this
+framework adds: each progress line past the first carries the instantaneous
+throughput (states/sec over the last sample interval), a moving-average
+rate over the recent sample window, and — when the run has a
+target_state_count — an ETA extrapolated from the moving average. The
+reference-compatible "Done." and "Checking." prefixes are unchanged, so
+anything grepping them keeps working.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, Dict, TextIO
+from typing import Any, Dict, Optional, TextIO
 
 
 @dataclass
@@ -20,10 +27,12 @@ class ReportData:
     max_depth: int
     duration_secs: float
     done: bool
-    # Engine-specific gauges (device engines: load factor, take_cap,
-    # steps/era, spill volume — reference report.rs has no equivalent;
-    # empty for engines without telemetry).
+    # Engine metrics-registry snapshot (counters, gauges, phase_ms — see
+    # obs/metrics.py; reference report.rs has no equivalent). Populated on
+    # the final sample.
     telemetry: Dict[str, Any] = None
+    # The run's target_state_count, when set — lets reporters compute ETA.
+    target_states: Optional[int] = None
 
 
 @dataclass
@@ -48,11 +57,48 @@ class Reporter:
         return 1.0
 
 
+def _fmt_rate(rate: float) -> str:
+    if rate >= 1e6:
+        return f"{rate / 1e6:.2f}M/s"
+    if rate >= 1e3:
+        return f"{rate / 1e3:.1f}k/s"
+    return f"{rate:.0f}/s"
+
+
 class WriteReporter(Reporter):
-    """Writes progress lines to a file-like object. Reference: report.rs:50-98."""
+    """Writes progress lines to a file-like object. Reference: report.rs:50-98.
+
+    Rate math: progress samples (duration, states) accumulate in a bounded
+    window; `rate` is the throughput over the latest sample interval,
+    `avg` the moving average across the whole window, and `eta` the
+    moving-average extrapolation to the run's target_state_count.
+    """
+
+    # Moving-average window: at the default 1s sample delay this averages
+    # over the last ~30s of progress.
+    WINDOW = 30
 
     def __init__(self, writer: TextIO):
         self.writer = writer
+        self._samples: deque = deque(maxlen=self.WINDOW)  # (secs, states)
+
+    def _rate_suffix(self, data: ReportData) -> str:
+        self._samples.append((data.duration_secs, data.total_states))
+        if len(self._samples) < 2:
+            return ""
+        (t0, s0) = self._samples[0]
+        (tp, sp) = self._samples[-2]
+        (tn, sn) = self._samples[-1]
+        # Sub-50ms windows (e.g. the first poll landing right after the
+        # initial snapshot) extrapolate absurd rates; wait for real data.
+        if tn - t0 < 0.05:
+            return ""
+        avg = (sn - s0) / (tn - t0)
+        inst = (sn - sp) / (tn - tp) if tn > tp else avg
+        suffix = f", rate={_fmt_rate(inst)}, avg={_fmt_rate(avg)}"
+        if data.target_states and avg > 0 and data.target_states > sn:
+            suffix += f", eta={int((data.target_states - sn) / avg)}s"
+        return suffix
 
     def report_checking(self, data: ReportData) -> None:
         if data.done:
@@ -60,6 +106,11 @@ class WriteReporter(Reporter):
                 f"Done. states={data.total_states}, unique={data.unique_states}, "
                 f"depth={data.max_depth}, sec={int(data.duration_secs)}\n"
             )
+            if data.duration_secs > 0:
+                self.writer.write(
+                    "Rate. states_per_sec="
+                    f"{data.total_states / data.duration_secs:.1f}\n"
+                )
             if data.telemetry:
                 pairs = ", ".join(
                     f"{k}={v}" for k, v in sorted(data.telemetry.items())
@@ -68,7 +119,8 @@ class WriteReporter(Reporter):
         else:
             self.writer.write(
                 f"Checking. states={data.total_states}, "
-                f"unique={data.unique_states}, depth={data.max_depth}\n"
+                f"unique={data.unique_states}, depth={data.max_depth}"
+                f"{self._rate_suffix(data)}\n"
             )
 
     def report_discoveries(self, model, discoveries: Dict[str, ReportDiscovery]) -> None:

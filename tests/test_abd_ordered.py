@@ -53,6 +53,41 @@ def test_ordered_more_states_than_unordered():
     assert dev_u.unique_state_count() == 544
 
 
+def test_ordered_send_rank_field_is_masked_before_rank_insertion():
+    # A handler payload that strays into the rank nibble (bits 16-19) must
+    # not pre-load a bogus FIFO rank: net_step_ordered masks sends down to
+    # their ORDERED_PAY_MASK payload before OR-ing in the real flow depth.
+    import numpy as np
+
+    from stateright_tpu.lanes import (
+        ORDERED_PAY_MASK,
+        RANK_FIELD,
+        RANK_SHIFT,
+        env_word,
+        net_step_ordered,
+    )
+
+    u = np.uint32
+    K = 3
+    # One in-flight rank-0 envelope on flow (1 -> 2); two empty slots.
+    head = env_word(np, 1, u(1), u(2), u(0x7))
+    net = [np.array([0], dtype=np.uint32),
+           np.array([0], dtype=np.uint32),
+           np.array([head], dtype=np.uint32)]
+    # Deliver slot 2 (the head) and send a reply on the SAME flow whose
+    # payload has rank-field bits set (a buggy 20-bit payload).
+    dirty_pay = u(0x3) << u(RANK_SHIFT) | u(0x5)
+    send = env_word(np, 2, u(1), u(2), dirty_pay)
+    out = net_step_ordered(np, net, np.array([2], dtype=np.uint32), [send])
+    inserted = [int(lane[0]) for lane in out if int(lane[0]) != 0]
+    assert len(inserted) == 1
+    word = inserted[0]
+    # The flow was emptied by the delivery, so the inserted send must sit
+    # at rank 0 with only its masked 16-bit payload surviving.
+    assert (word & RANK_FIELD) >> RANK_SHIFT == 0
+    assert word & ORDERED_PAY_MASK == 0x5
+
+
 def test_ordered_c3_device_golden():
     dev = (
         TensorModelAdapter(AbdOrderedTensor(3))

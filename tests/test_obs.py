@@ -1,0 +1,343 @@
+"""Observability layer (stateright_tpu/obs): registry semantics, the JSONL
+trace schema, reporter rate/ETA math, the uniform Checker.telemetry()
+surface across every engine, and the Explorer /metrics endpoint.
+"""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
+from stateright_tpu.models.fixtures import BinaryClock, LinearEquation
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.obs.metrics import MetricsRegistry
+from stateright_tpu.report import ReportData, WriteReporter
+
+REQUIRED_KEYS = {"ts", "seq", "engine", "event"}
+PROGRESS_KEYS = {"states", "unique", "frontier", "max_depth", "phase_ms"}
+
+
+# -- registry -----------------------------------------------------------------
+
+
+def test_registry_counters_gauges_phases():
+    m = MetricsRegistry()
+    m.inc("eras")
+    m.inc("eras")
+    m.inc("steps", 5)
+    m.set_gauge("take_cap", 128)
+    m.set_gauge("take_cap", 64)  # gauges overwrite
+    with m.phase("device_era"):
+        pass
+    with m.phase("device_era"):
+        pass
+    m.add_phase("readback", 0.25)
+    snap = m.snapshot()
+    assert snap["eras"] == 2
+    assert snap["steps"] == 5
+    assert snap["take_cap"] == 64
+    assert snap["phase_ms"]["readback"] == 250.0
+    assert snap["phase_ms"]["device_era"] >= 0.0
+    assert m.get("eras") == 2
+    assert m.get("missing", 7) == 7
+    # phase_ms() is cumulative and sorted by name
+    assert list(m.phase_ms()) == ["device_era", "readback"]
+
+
+def test_registry_snapshot_is_a_copy():
+    m = MetricsRegistry()
+    m.inc("eras")
+    snap = m.snapshot()
+    snap["eras"] = 999
+    assert m.snapshot()["eras"] == 1
+
+
+# -- trace JSONL --------------------------------------------------------------
+
+
+def _parse_trace(path):
+    with open(path) as f:
+        lines = [json.loads(line) for line in f]  # every line must parse
+    assert lines, "trace is empty"
+    assert [rec["seq"] for rec in lines] == list(range(len(lines)))
+    for rec in lines:
+        assert REQUIRED_KEYS <= set(rec), rec
+    assert lines[0]["event"] == "run_start"
+    assert lines[-1]["event"] == "run_end"
+    return lines
+
+
+def test_trace_jsonl_schema_device_engine(tmp_path):
+    """Acceptance: CheckerBuilder.trace(path) on a 2pc-3 run produces valid
+    JSONL with per-era phase timings."""
+    path = str(tmp_path / "run.jsonl")
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .trace(path)
+        .spawn_tpu_bfs(chunk_size=128)
+        .join()
+    )
+    assert c.unique_state_count() == 288
+    lines = _parse_trace(path)
+    eras = [rec for rec in lines if rec["event"] == "era"]
+    assert eras, "device run emitted no era events"
+    for rec in eras:
+        assert PROGRESS_KEYS <= set(rec), rec
+        assert {"load_factor", "take_cap", "steps", "generated",
+                "spill_rows"} <= set(rec)
+        assert "device_era" in rec["phase_ms"]
+        assert rec["phase_ms"]["device_era"] >= 0.0
+    # Final event reconciles with the checker's own counters.
+    assert lines[-1]["states"] == c.state_count()
+    assert lines[-1]["unique"] == c.unique_state_count()
+
+
+def test_trace_jsonl_schema_host_engine(tmp_path):
+    path = str(tmp_path / "host.jsonl")
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .trace(path)
+        .spawn_bfs()
+        .join()
+    )
+    lines = _parse_trace(path)
+    waves = [rec for rec in lines if rec["event"] == "wave"]
+    assert waves
+    for rec in waves:
+        assert PROGRESS_KEYS <= set(rec)
+        assert "check_block" in rec["phase_ms"]
+
+
+def test_profile_option_is_harmless(tmp_path):
+    # jax.profiler may or may not be usable on this backend; .profile()
+    # must never break the run either way.
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .profile(str(tmp_path / "prof"))
+        .spawn_bfs()
+        .join()
+    )
+    assert c.unique_state_count() == 288
+
+
+# -- reporter rate / ETA math -------------------------------------------------
+
+
+def test_reporter_rate_moving_average_and_eta():
+    out = io.StringIO()
+    r = WriteReporter(out)
+    mk = lambda states, secs: ReportData(
+        total_states=states,
+        unique_states=states,
+        max_depth=1,
+        duration_secs=secs,
+        done=False,
+        target_states=1_000,
+    )
+    r.report_checking(mk(0, 0.0))
+    r.report_checking(mk(100, 1.0))
+    r.report_checking(mk(300, 2.0))
+    lines = out.getvalue().splitlines()
+    # First sample: reference-compatible line, no rate suffix yet.
+    assert lines[0] == "Checking. states=0, unique=0, depth=1"
+    # Second: rate == avg == 100/s; eta = (1000-100)/100 = 9s.
+    assert "rate=100/s" in lines[1]
+    assert "avg=100/s" in lines[1]
+    assert "eta=9s" in lines[1]
+    # Third: instantaneous (300-100)/1 = 200/s, window avg 300/2 = 150/s,
+    # eta = (1000-300)/150 = 4s.
+    assert "rate=200/s" in lines[2]
+    assert "avg=150/s" in lines[2]
+    assert "eta=4s" in lines[2]
+
+
+def test_reporter_done_line_unchanged_and_rate_appended():
+    out = io.StringIO()
+    r = WriteReporter(out)
+    r.report_checking(
+        ReportData(
+            total_states=1_000,
+            unique_states=900,
+            max_depth=7,
+            duration_secs=2.0,
+            done=True,
+            telemetry={"eras": 3},
+        )
+    )
+    text = out.getvalue()
+    assert text.startswith("Done. states=1000, unique=900, depth=7, sec=2\n")
+    assert "Rate. states_per_sec=500.0" in text
+    assert "Telemetry. eras=3" in text
+
+
+def test_reporter_rate_units():
+    from stateright_tpu.report import _fmt_rate
+
+    assert _fmt_rate(12.0) == "12/s"
+    assert _fmt_rate(4_200.0) == "4.2k/s"
+    assert _fmt_rate(2_500_000.0) == "2.50M/s"
+
+
+# -- Checker.telemetry() non-empty for EVERY engine ---------------------------
+
+
+def _assert_live_telemetry(checker):
+    t = checker.telemetry()
+    assert isinstance(t, dict) and t, t
+    assert t.get("engine")
+    # More than just the engine tag: the registry actually got populated.
+    assert len(t) > 1, t
+    return t
+
+
+def test_telemetry_spawn_bfs():
+    _assert_live_telemetry(
+        LinearEquation(2, 10, 14).checker().spawn_bfs().join()
+    )
+
+
+def test_telemetry_spawn_dfs():
+    _assert_live_telemetry(
+        LinearEquation(2, 10, 14).checker().spawn_dfs().join()
+    )
+
+
+def test_telemetry_spawn_on_demand():
+    c = BinaryClock().checker().spawn_on_demand()
+    _assert_live_telemetry(c)  # non-empty even before it is driven
+    c.run_to_completion()
+    c.join()
+    t = _assert_live_telemetry(c)
+    assert t["waves"] >= 1
+
+
+def test_telemetry_spawn_simulation():
+    c = (
+        TwoPhaseSys(3)
+        .checker()
+        .target_state_count(200)
+        .spawn_simulation(7)
+        .join()
+    )
+    t = _assert_live_telemetry(c)
+    assert t["traces"] >= 1
+    assert "walk" in t["phase_ms"]
+
+
+def test_telemetry_spawn_parallel_bfs():
+    c = TwoPhaseSys(3).checker().threads(2).spawn_parallel_bfs().join()
+    t = _assert_live_telemetry(c)
+    assert t["workers"] == 2
+    assert t["rounds"] >= 1
+
+
+def test_telemetry_spawn_vbfs():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .threads(2)
+        .spawn_vbfs()
+        .join()
+    )
+    t = _assert_live_telemetry(c)
+    assert t["waves"] >= 1
+    for phase in ("property_eval", "expand", "hash", "visited_insert"):
+        assert phase in t["phase_ms"]
+
+
+def test_telemetry_spawn_tpu_bfs():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .spawn_tpu_bfs(chunk_size=128)
+        .join()
+    )
+    t = _assert_live_telemetry(c)
+    assert t["eras"] >= 1 and t["steps"] >= 1
+    assert "device_era" in t["phase_ms"]
+
+
+def test_telemetry_spawn_tpu_simulation():
+    c = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .target_state_count(100)
+        .spawn_tpu_simulation(7, walks=32, walk_cap=16)
+        .join()
+    )
+    t = _assert_live_telemetry(c)
+    assert t["eras"] >= 1
+    assert t["walks"] == 32
+
+
+def test_telemetry_spawn_sharded_bfs():
+    try:
+        from jax import shard_map  # noqa: F401
+    except ImportError:
+        pytest.skip("jax.shard_map unavailable on this jax version")
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .spawn_sharded_bfs(
+            chunk_size=128,
+            queue_capacity_per_shard=1 << 12,
+            table_capacity_per_shard=1 << 12,
+        )
+        .join()
+    )
+    t = _assert_live_telemetry(c)
+    assert t["eras"] >= 1
+    assert t["n_shards"] >= 1
+
+
+# -- Explorer /metrics --------------------------------------------------------
+
+
+def test_explorer_metrics_endpoint():
+    from stateright_tpu.explorer.server import serve
+
+    server = serve(BinaryClock().checker(), "127.0.0.1:0", block=False)
+    try:
+        base = server.url.rstrip("/")
+
+        def get_json(path):
+            with urllib.request.urlopen(base + path) as r:
+                assert r.status == 200
+                return json.loads(r.read())
+
+        m = get_json("/metrics")
+        for key in ("ts", "done", "state_count", "unique_state_count",
+                    "max_depth", "telemetry"):
+            assert key in m, m
+        assert m["telemetry"], "telemetry must be non-empty"
+        # The dot-prefixed alias matches the other API routes.
+        assert get_json("/.metrics")["telemetry"]
+
+        req = urllib.request.Request(base + "/.runtocompletion", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        server.checker.join()
+        m2 = get_json("/metrics")
+        assert m2["done"] is True
+        assert m2["unique_state_count"] == 2
+        assert m2["telemetry"]["waves"] >= 1
+    finally:
+        server.shutdown()
+
+
+def test_explorer_ui_ships_metrics_panel():
+    # The SPA bundle must actually wire the dashboard: panel in the page,
+    # polling + sparkline logic in the script.
+    from pathlib import Path
+
+    ui = Path(__file__).parent.parent / "stateright_tpu" / "explorer" / "ui"
+    html = (ui / "index.html").read_text()
+    js = (ui / "app.js").read_text()
+    assert "metrics-panel" in html and "sparkline" in html
+    assert "/metrics" in js and "pollMetrics" in js

@@ -119,6 +119,58 @@ def test_target_state_count_bounds_run():
     assert c.state_count() >= 5_000
 
 
+class ChainFork(TensorModel):
+    """0 -(+1|+2)-> ... until v >= N (terminal). The SOMETIMES property
+    freezes any walk that lands on 1; the EVENTUALLY property is satisfied
+    at every terminal state, so an honest run can never produce an
+    EVENTUALLY counterexample."""
+
+    state_width = 1
+    max_actions = 2
+    N = 6
+
+    def init_states_array(self):
+        return np.zeros((1, 1), dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        (v,) = lanes
+        ok = v < xp.uint32(self.N)
+        return [(v + xp.uint32(1),), (v + xp.uint32(2),)], [ok, ok]
+
+    def tensor_properties(self):
+        return [
+            TensorProperty.sometimes(
+                "at one", lambda xp, l: l[0] == xp.uint32(1)
+            ),
+            TensorProperty.eventually(
+                "reaches end", lambda xp, l: l[0] >= xp.uint32(self.N)
+            ),
+        ]
+
+
+def test_frozen_walks_cannot_fake_eventually_counterexamples():
+    """Regression: a walk freezes when it records a discovery, with its
+    current state already in its own path buffer. The old code dropped the
+    frozen lane at the era boundary, so the walk thawed next era, matched
+    ITSELF in the cycle check, and the fake cycle's surviving
+    eventually-bits were reported as an EVENTUALLY counterexample. Small
+    sync_steps forces many era boundaries while walks sit frozen."""
+    tm = ChainFork()
+    c = (
+        TensorModelAdapter(tm)
+        .checker()
+        .target_state_count(3_000)
+        .timeout(60)  # safety net only; the run ends on the target
+        .spawn_tpu_simulation(13, walks=64, walk_cap=32, sync_steps=4)
+        .join()
+    )
+    assert c.discovery("at one") is not None
+    # Every terminal satisfies the eventually property, so any reported
+    # counterexample is fabricated.
+    assert c.discovery("reaches end") is None
+    assert c.state_count() >= 3_000  # frozen walks restart; no starvation
+
+
 def test_host_simulation_threads():
     # .threads(n) on the host engine runs n seed streams (reference
     # simulation.rs:138-201) instead of raising.
