@@ -92,6 +92,42 @@ def main() -> None:
     detail = {}
     result = {}
 
+    # --- speclint pre-flight ----------------------------------------------
+    # Fast static analysis of every bench model BEFORE spending device
+    # time on it (a fast engine checking a broken spec benches nothing);
+    # diagnostic counts per code ride the BENCH json next to telemetry.
+    from stateright_tpu.analysis import analyze
+
+    from stateright_tpu.models import AbdOrderedTensor as _AbdO
+    from stateright_tpu.models import AbdTensor as _Abd
+    from stateright_tpu.models import SingleCopyTensor as _SC
+
+    speclint = {}
+    for mk in (
+        lambda: TwoPhaseTensor(7),
+        lambda: PaxosTensorExhaustive(2),
+        lambda: _Abd(2),
+        lambda: _AbdO(3),
+        lambda: IncrementTensor(2),
+        lambda: _SC(3, 2),
+    ):
+        m = mk()
+        # 64 samples keeps the pre-flight under ~1 min even for the paxos
+        # lane program (whose single-row adapter steps dominate replay
+        # cost) while still exercising every rule family.
+        rep = analyze(m, samples=64)
+        speclint[type(m).__name__] = {
+            "ok": rep.ok,
+            "errors": len(rep.errors),
+            "warnings": len(rep.warnings),
+            "counts_by_code": rep.counts_by_code(),
+        }
+        assert rep.ok, (
+            f"speclint found errors on bench model {type(m).__name__}:\n"
+            + rep.format()
+        )
+    detail["speclint"] = speclint
+
     def emit(value, vs_baseline, partial):
         result.update(
             {

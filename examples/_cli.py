@@ -2,8 +2,11 @@
 
 Role parity with the per-example pico-args CLIs in the reference
 (e.g. examples/paxos.rs:354-510): each example exposes `check` /
-`check-dfs` / `check-simulation` / `explore` / `spawn` subcommands with
-positional arguments for problem size and network semantics.
+`check-dfs` / `check-simulation` / `lint` / `explore` / `spawn`
+subcommands with positional arguments for problem size and network
+semantics. `lint` runs the speclint static analysis
+(stateright_tpu.analysis) instead of a checking run; its exit status is
+nonzero when error-severity diagnostics are found.
 """
 
 from __future__ import annotations
@@ -42,6 +45,16 @@ def example_main(
         else:
             checker = builder.spawn_bfs()
         checker.report(WriteReporter(sys.stdout))
+    elif subcommand == "lint":
+        from stateright_tpu.analysis import analyze
+
+        client_count = int(arg(0, default_client_count))
+        network = Network.from_name(arg(1, default_network))
+        print(f"Linting {name} with {client_count} clients.")
+        report = analyze(build_model(client_count, network))
+        print(report.format())
+        if not report.ok:
+            raise SystemExit(1)
     elif subcommand == "explore":
         client_count = int(arg(0, default_client_count))
         address = arg(1, "localhost:3000")
@@ -58,5 +71,8 @@ def example_main(
             raise SystemExit(1)
         spawn_info()
     else:
-        print(f"Usage: {sys.argv[0]} [check|check-dfs|check-simulation|explore|spawn]")
+        print(
+            f"Usage: {sys.argv[0]} "
+            "[check|check-dfs|check-simulation|lint|explore|spawn]"
+        )
         raise SystemExit(1)

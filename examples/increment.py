@@ -9,6 +9,7 @@ Usage::
     python examples/increment.py check [THREAD_COUNT]
     python examples/increment.py check-sym [THREAD_COUNT]
     python examples/increment.py check-tpu [THREAD_COUNT]
+    python examples/increment.py lint [THREAD_COUNT]
 """
 
 from __future__ import annotations
@@ -38,9 +39,22 @@ def main(argv=None):
         IncrementTensor(thread_count).checker().spawn_tpu_bfs().report(
             WriteReporter(sys.stdout)
         )
+    elif subcommand == "lint":
+        from stateright_tpu.analysis import analyze
+
+        ok = True
+        for model in (Increment(thread_count), IncrementTensor(thread_count)):
+            report = analyze(model)
+            print(report.format())
+            ok = ok and report.ok
+        if not ok:
+            raise SystemExit(1)
     else:
         print("USAGE:")
-        print("  python examples/increment.py [check|check-sym|check-tpu] [THREAD_COUNT]")
+        print(
+            "  python examples/increment.py "
+            "[check|check-sym|check-tpu|lint] [THREAD_COUNT]"
+        )
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ Usage::
     python examples/two_phase_commit.py check [RM_COUNT]
     python examples/two_phase_commit.py check-sym [RM_COUNT]
     python examples/two_phase_commit.py check-tpu [RM_COUNT]
+    python examples/two_phase_commit.py lint [RM_COUNT]
     python examples/two_phase_commit.py explore [RM_COUNT] [ADDRESS]
 """
 
@@ -52,6 +53,17 @@ def main(argv=None):
         TwoPhaseTensor(rm_count).checker().spawn_tpu_bfs().report(
             WriteReporter(sys.stdout)
         )
+    elif subcommand == "lint":
+        from stateright_tpu.analysis import analyze
+
+        print(f"Linting two phase commit with {rm_count} resource managers.")
+        ok = True
+        for model in (TwoPhaseSys(rm_count), TwoPhaseTensor(rm_count)):
+            report = analyze(model)
+            print(report.format())
+            ok = ok and report.ok
+        if not ok:
+            raise SystemExit(1)
     elif subcommand == "explore":
         address = arg(1, "localhost:3000")
         print(
@@ -64,6 +76,7 @@ def main(argv=None):
         print("  python examples/two_phase_commit.py check [RM_COUNT]")
         print("  python examples/two_phase_commit.py check-sym [RM_COUNT]")
         print("  python examples/two_phase_commit.py check-tpu [RM_COUNT]")
+        print("  python examples/two_phase_commit.py lint [RM_COUNT]")
         print("  python examples/two_phase_commit.py explore [RM_COUNT] [ADDRESS]")
 
 

@@ -25,6 +25,7 @@ Public API parity map (reference file:line cited in each module's docstring):
 
 from .core import Expectation, Model, Property, fingerprint
 from .checker import Checker, CheckerBuilder, DiscoveryClassification
+from .analysis import AnalysisReport, SpecLintError, analyze
 from .has_discoveries import HasDiscoveries
 from .path import Path
 from .report import ReportData, ReportDiscovery, Reporter, WriteReporter
@@ -35,10 +36,13 @@ from .utils import DenseNatMap, VectorClock
 from .engines.simulation import Chooser, UniformChooser
 
 __all__ = [
+    "AnalysisReport",
     "Checker",
     "CheckerBuilder",
     "CheckerVisitor",
     "Chooser",
+    "SpecLintError",
+    "analyze",
     "DenseNatMap",
     "DiscoveryClassification",
     "Expectation",

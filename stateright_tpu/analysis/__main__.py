@@ -1,0 +1,116 @@
+"""Command-line speclint: ``python -m stateright_tpu.analysis MODEL``.
+
+MODEL is either a bundled-model shorthand (``NAME`` or ``NAME:ARGS`` with
+comma-separated int args, e.g. ``2pc:5``, ``increment:2``, ``abd:2``) or
+a dotted constructor path ``package.module:Factory:ARGS`` for user
+models. Exit status is the CI contract: 0 = no error-severity findings,
+1 = errors found, 2 = usage problems.
+
+Examples::
+
+    python -m stateright_tpu.analysis 2pc:5
+    python -m stateright_tpu.analysis paxos:2 --samples 512 --json
+    python -m stateright_tpu.analysis mypkg.mymodel:MyTensor:3 --strict
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+from typing import Any, Callable, Dict
+
+from . import ALL_FAMILIES, analyze
+
+# Bundled-model shorthands (lint targets double as living documentation
+# of the registry; the dogfood test asserts all of them lint clean).
+BUNDLED: Dict[str, Callable[..., Any]] = {}
+
+
+def _register() -> None:
+    from ..models import (
+        AbdOrderedTensor,
+        AbdTensor,
+        Increment,
+        IncrementLock,
+        IncrementLockTensor,
+        IncrementTensor,
+        PaxosTensor,
+        SingleCopyTensor,
+        TwoPhaseSys,
+        TwoPhaseTensor,
+    )
+
+    BUNDLED.update(
+        {
+            "2pc": TwoPhaseTensor,
+            "2pc-host": TwoPhaseSys,
+            "abd": AbdTensor,
+            "abd-ordered": AbdOrderedTensor,
+            "increment": IncrementTensor,
+            "increment-host": Increment,
+            "increment-lock": IncrementLockTensor,
+            "increment-lock-host": IncrementLock,
+            "paxos": PaxosTensor,
+            "single-copy": SingleCopyTensor,
+        }
+    )
+
+
+def resolve_model(spec: str):
+    """``NAME[:ARGS]`` (bundled) or ``pkg.module:Factory[:ARGS]``."""
+    _register()
+    parts = spec.split(":")
+    if parts[0] in BUNDLED:
+        factory = BUNDLED[parts[0]]
+        args = [int(a) for a in parts[1].split(",")] if len(parts) > 1 and parts[1] else []
+        return factory(*args)
+    if "." in parts[0] and len(parts) >= 2:
+        mod = importlib.import_module(parts[0])
+        factory = getattr(mod, parts[1])
+        args = [int(a) for a in parts[2].split(",")] if len(parts) > 2 and parts[2] else []
+        return factory(*args)
+    print(
+        f"unknown model {spec!r}; bundled: {', '.join(sorted(BUNDLED))} "
+        "(append :ARGS, e.g. 2pc:5), or pkg.module:Factory:ARGS",
+        file=sys.stderr,
+    )
+    raise SystemExit(2)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m stateright_tpu.analysis",
+        description="pre-flight static analysis of a model "
+        "(determinism, device compatibility, properties, symmetry)",
+    )
+    parser.add_argument("model", help="bundled shorthand (2pc:5) or pkg.module:Factory:ARGS")
+    parser.add_argument(
+        "--samples", type=int, default=256,
+        help="breadth-first state-sample budget (default 256)",
+    )
+    parser.add_argument(
+        "--families", default=",".join(ALL_FAMILIES),
+        help=f"comma-separated rule families (default: all of {','.join(ALL_FAMILIES)})",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the report as one JSON object"
+    )
+    args = parser.parse_args(argv)
+
+    model = resolve_model(args.model)
+    report = analyze(
+        model,
+        samples=args.samples,
+        families=[f.strip() for f in args.families.split(",") if f.strip()],
+    )
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.format())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

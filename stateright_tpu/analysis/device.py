@@ -1,0 +1,387 @@
+"""STR2xx — device (jit/encoding) compatibility of TensorModels.
+
+A `TensorModel` that breaks these rules fails LATE — inside a jitted
+era loop (often under shard_map), where the XLA error names a lowered
+primitive and nothing of the user's code — or worse, silently: lane
+values past the uint32 packing truncate inside the fingerprint stream
+and distinct states merge. These rules trace and execute `step_lanes`
+OUTSIDE the engines, on a small batch, where failures are attributable.
+
+Codes:
+  STR201  step_lanes / within_boundary_lanes is not jit-traceable
+  STR202  step_lanes output structure/shape/dtype is wrong or unstable
+  STR203  init_states_array is malformed (shape/dtype/value range)
+  STR204  decode_state raises on reachable rows
+  STR205  numpy and jax evaluations of step_lanes disagree (host oracle
+          and device engine would explore different systems)
+  STR206  within_boundary_lanes output is not a bool[B]
+  STR207  step_lanes output dtype drifts off uint32 (promotion), or lane
+          values overflow the uint32 fingerprint packing
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from ..tensor import TensorModel
+from .diagnostics import AnalysisReport, Severity
+
+_U32_MAX = 0xFFFFFFFF
+
+
+def _loc(tm: TensorModel, member: str) -> str:
+    return f"{type(tm).__name__}.{member}"
+
+
+def run(tm: TensorModel, rows: np.ndarray, report: AnalysisReport) -> None:
+    """Run the device rules over `rows` ([B, S] sampled states; row 0..n
+    include the init states)."""
+    report.families_run.append("device")
+    S = getattr(tm, "state_width", None)
+    A = getattr(tm, "max_actions", None)
+    if not isinstance(S, int) or not isinstance(A, int) or S <= 0 or A <= 0:
+        report.add(
+            "STR203",
+            Severity.ERROR,
+            f"state_width/max_actions must be positive ints "
+            f"(got {S!r}/{A!r})",
+            _loc(tm, "state_width"),
+            "declare both as class or instance attributes",
+        )
+        return
+
+    if not _check_init_array(tm, report, S):
+        return
+    if rows.size == 0:
+        return
+    lanes = tuple(np.ascontiguousarray(rows[:, i]) for i in range(S))
+
+    np_out = _check_numpy_step(tm, lanes, report, S, A)
+    jax_ok = _check_traceability(tm, rows.shape[0], report, S, A)
+    if np_out is not None and jax_ok:
+        _check_host_device_agreement(tm, lanes, np_out, report)
+    _check_boundary(tm, lanes, report)
+    _check_decode(tm, rows, report)
+
+
+def _check_init_array(tm: TensorModel, report: AnalysisReport, S: int) -> bool:
+    try:
+        arr = np.asarray(tm.init_states_array())
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR203",
+            Severity.ERROR,
+            f"init_states_array raised {type(e).__name__}: {e}",
+            _loc(tm, "init_states_array"),
+            "return a [N, state_width] uint32 array",
+        )
+        return False
+    if arr.ndim != 2 or arr.shape[1] != S:
+        report.add(
+            "STR203",
+            Severity.ERROR,
+            f"init_states_array has shape {arr.shape}; expected "
+            f"[N, state_width={S}]",
+            _loc(tm, "init_states_array"),
+            "return a 2-D row matrix, one row per initial state",
+        )
+        return False
+    if arr.shape[0] == 0:
+        report.add(
+            "STR203",
+            Severity.WARNING,
+            "init_states_array is empty; the checker will explore nothing",
+            _loc(tm, "init_states_array"),
+            "provide at least one initial state",
+        )
+        return False
+    if not np.issubdtype(arr.dtype, np.integer):
+        report.add(
+            "STR203",
+            Severity.ERROR,
+            f"init_states_array dtype is {arr.dtype}; lane packing and "
+            "the fingerprint word stream require integers",
+            _loc(tm, "init_states_array"),
+            "encode state fields into uint32 lanes",
+        )
+        return False
+    lo = int(arr.min())
+    hi = int(arr.max())
+    if lo < 0 or hi > _U32_MAX:
+        report.add(
+            "STR207",
+            Severity.ERROR,
+            f"init_states_array values span [{lo}, {hi}], outside the "
+            "uint32 lane packing; the cast truncates silently and distinct "
+            "states would share fingerprints",
+            _loc(tm, "init_states_array"),
+            "split wide fields across multiple lanes or shrink the domain",
+        )
+        return False
+    return True
+
+
+def _check_numpy_step(tm, lanes, report: AnalysisReport, S: int, A: int):
+    try:
+        succs, masks = tm.step_lanes(np, lanes)
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR202",
+            Severity.ERROR,
+            f"step_lanes raised under numpy on sampled rows: "
+            f"{type(e).__name__}: {e}",
+            _loc(tm, "step_lanes"),
+            "step_lanes must be a pure array program valid for xp=numpy",
+        )
+        return None
+    B = lanes[0].shape[0]
+    if len(succs) != A or len(masks) != A:
+        report.add(
+            "STR202",
+            Severity.ERROR,
+            f"step_lanes returned {len(succs)} successor slots and "
+            f"{len(masks)} masks; expected max_actions={A} of each",
+            _loc(tm, "step_lanes"),
+            "emit one (successor lanes, validity mask) pair per static "
+            "action slot",
+        )
+        return None
+    dtype_reported = False
+    overflow_reported = False
+    for a in range(A):
+        slot = succs[a]
+        if len(slot) != S:
+            report.add(
+                "STR202",
+                Severity.ERROR,
+                f"action slot {a} has {len(slot)} lanes; expected "
+                f"state_width={S}",
+                _loc(tm, "step_lanes"),
+                "every successor must carry all state lanes",
+            )
+            return None
+        mask = np.asarray(masks[a])
+        if mask.shape != (B,) or mask.dtype != np.bool_:
+            report.add(
+                "STR202",
+                Severity.ERROR,
+                f"action slot {a} validity mask has shape {mask.shape} "
+                f"dtype {mask.dtype}; expected bool[{B}]",
+                _loc(tm, "step_lanes"),
+                "masks must be elementwise boolean over the batch",
+            )
+            return None
+        for s in range(S):
+            lane = np.asarray(slot[s])
+            if lane.shape != (B,):
+                report.add(
+                    "STR202",
+                    Severity.ERROR,
+                    f"action {a} lane {s} has shape {lane.shape}; expected "
+                    f"[{B}] (batch-shape-stable)",
+                    _loc(tm, "step_lanes"),
+                    "lane programs must stay elementwise over the batch "
+                    "axis",
+                )
+                return None
+            if lane.dtype != np.uint32 and not dtype_reported:
+                vals = lane[mask] if mask.any() else lane[:0]
+                overflow = vals.size and (
+                    (vals.min() < 0) or (vals.max() > _U32_MAX)
+                )
+                report.add(
+                    "STR207",
+                    Severity.ERROR if overflow else Severity.WARNING,
+                    f"action {a} lane {s} has dtype {lane.dtype} under "
+                    "numpy (promotion off uint32)"
+                    + (
+                        "; VALID successor values overflow the uint32 "
+                        "packing — fingerprints would silently truncate"
+                        if overflow
+                        else "; values still fit but the promotion usually "
+                        "signals an unwrapped Python-int constant"
+                    ),
+                    _loc(tm, "step_lanes"),
+                    "wrap constants as xp.uint32(...) so arithmetic stays "
+                    "in-lane",
+                )
+                dtype_reported = True
+                overflow_reported = overflow
+            elif lane.dtype == np.uint32 and not overflow_reported:
+                pass  # uint32 cannot overflow the packing by construction
+    return succs, masks
+
+
+def _check_traceability(tm, B: int, report: AnalysisReport, S: int, A: int) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    spec = tuple(
+        jax.ShapeDtypeStruct((B,), jnp.uint32) for _ in range(S)
+    )
+    try:
+        out = jax.eval_shape(lambda l: tm.step_lanes(jnp, l), spec)
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR201",
+            Severity.ERROR,
+            f"step_lanes fails to trace under jax.jit: "
+            f"{type(e).__name__}: {str(e).splitlines()[0] if str(e) else e}",
+            _loc(tm, "step_lanes"),
+            "remove data-dependent Python control flow (if/while on lane "
+            "values); express branches as xp.where masks",
+        )
+        return False
+    succs, masks = out
+    for a in range(A):
+        for s in range(S):
+            sd = succs[a][s]
+            if tuple(sd.shape) != (B,) or sd.dtype != jnp.uint32:
+                report.add(
+                    "STR202",
+                    Severity.ERROR,
+                    f"traced action {a} lane {s} has shape "
+                    f"{tuple(sd.shape)} dtype {sd.dtype}; the era loop "
+                    f"carries uint32[{B}] lanes and XLA requires static "
+                    "shapes",
+                    _loc(tm, "step_lanes"),
+                    "keep lane programs elementwise and uint32 end to end",
+                )
+                return False
+        md = masks[a]
+        if tuple(md.shape) != (B,) or md.dtype != jnp.bool_:
+            report.add(
+                "STR202",
+                Severity.ERROR,
+                f"traced action {a} mask has shape {tuple(md.shape)} "
+                f"dtype {md.dtype}; expected bool[{B}]",
+                _loc(tm, "step_lanes"),
+                "derive masks from lane comparisons only",
+            )
+            return False
+    return True
+
+
+def _check_host_device_agreement(tm, lanes, np_out, report: AnalysisReport):
+    import jax
+    import jax.numpy as jnp
+
+    np_succs, np_masks = np_out
+
+    @jax.jit
+    def step(l):
+        return tm.step_lanes(jnp, l)
+
+    try:
+        j_succs, j_masks = step(tuple(jnp.asarray(l) for l in lanes))
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR201",
+            Severity.ERROR,
+            f"step_lanes traced but failed to execute under jit: "
+            f"{type(e).__name__}: {e}",
+            _loc(tm, "step_lanes"),
+            "check gather indices and dynamic slices stay in bounds",
+        )
+        return
+    A = len(np_masks)
+    S = len(lanes)
+    for a in range(A):
+        nm = np.asarray(np_masks[a])
+        jm = np.asarray(j_masks[a])
+        if not np.array_equal(nm, jm):
+            report.add(
+                "STR205",
+                Severity.ERROR,
+                f"action {a} validity mask differs between numpy and jax "
+                f"evaluation ({int(nm.sum())} vs {int(jm.sum())} valid); "
+                "the host oracle and the device engine would explore "
+                "different transition systems",
+                _loc(tm, "step_lanes"),
+                "avoid numpy-only semantics (value-dependent dtypes, "
+                "Python bool casts); keep the program in the shared "
+                "xp subset",
+            )
+            return
+        for s in range(S):
+            nl = np.asarray(np_succs[a][s]).astype(np.uint32)[nm]
+            jl = np.asarray(j_succs[a][s]).astype(np.uint32)[nm]
+            if not np.array_equal(nl, jl):
+                i = int(np.nonzero(nl != jl)[0][0])
+                report.add(
+                    "STR205",
+                    Severity.ERROR,
+                    f"action {a} lane {s} differs between numpy and jax "
+                    f"on a VALID successor (first mismatch at batch row "
+                    f"{i}: {int(nl[i])} vs {int(jl[i])}); host/device "
+                    "fingerprints would diverge",
+                    _loc(tm, "step_lanes"),
+                    "uint32 wraparound and shift semantics differ off the "
+                    "shared subset; keep all arithmetic in xp.uint32",
+                )
+                return
+
+
+def _check_boundary(tm, lanes, report: AnalysisReport):
+    import jax
+    import jax.numpy as jnp
+
+    B = lanes[0].shape[0]
+    try:
+        nb = np.asarray(tm.within_boundary_lanes(np, lanes))
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR206",
+            Severity.ERROR,
+            f"within_boundary_lanes raised under numpy: "
+            f"{type(e).__name__}: {e}",
+            _loc(tm, "within_boundary_lanes"),
+            "return xp.ones(B, bool) when every state is in bounds",
+        )
+        return
+    if nb.shape != (B,) or nb.dtype != np.bool_:
+        report.add(
+            "STR206",
+            Severity.ERROR,
+            f"within_boundary_lanes returned shape {nb.shape} dtype "
+            f"{nb.dtype}; expected bool[{B}]",
+            _loc(tm, "within_boundary_lanes"),
+            "return one boolean per batch row",
+        )
+        return
+    spec = tuple(jax.ShapeDtypeStruct((B,), jnp.uint32) for _ in lanes)
+    try:
+        jax.eval_shape(lambda l: tm.within_boundary_lanes(jnp, l), spec)
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR201",
+            Severity.ERROR,
+            f"within_boundary_lanes fails to trace under jax.jit: "
+            f"{type(e).__name__}: {str(e).splitlines()[0] if str(e) else e}",
+            _loc(tm, "within_boundary_lanes"),
+            "express the boundary as mask arithmetic over lanes",
+        )
+
+
+def _check_decode(tm, rows: np.ndarray, report: AnalysisReport):
+    bad: List[Any] = []
+    for row in rows:
+        try:
+            tm.decode_state(np.asarray(row, dtype=np.uint32))
+        except BaseException as e:  # noqa: BLE001
+            bad.append((row, e))
+            break
+    if bad:
+        row, e = bad[0]
+        report.add(
+            "STR204",
+            Severity.ERROR,
+            f"decode_state raised {type(e).__name__} on reachable row "
+            f"{row.tolist()}: {e}; the Explorer and counterexample "
+            "rendering would crash on it",
+            _loc(tm, "decode_state"),
+            "decode every encodable lane combination reachable from the "
+            "initial states",
+        )

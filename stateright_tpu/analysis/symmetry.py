@@ -1,0 +1,237 @@
+"""STR4xx — symmetry-reduction soundness.
+
+Symmetry reduction replaces states by canonical representatives before
+dedup. Three contracts make that sound, and breaking any of them is
+invisible at runtime (the run just quietly explores the wrong quotient):
+
+  - idempotence: rep(rep(s)) == rep(s). A non-idempotent canonicalizer
+    makes the visited set treat a representative as unvisited, re-deriving
+    different "canonical" forms forever (or until the table fills).
+  - property preservation: every declared property must agree on s and
+    rep(s) — otherwise the quotient search proves facts about states
+    nobody asked about.
+  - host/device agreement (tensor models): `representative_lanes` must
+    give bit-identical results under numpy and jax, or the host oracle
+    and device engine canonicalize into different quotients.
+
+Codes:
+  STR401  representative() raises on a sampled state
+  STR402  representative is not idempotent
+  STR403  a property value changes under canonicalization
+  STR404  representative_lanes disagrees between numpy and jax
+  STR405  orbit states map to different representatives (warning —
+          an IMPERFECT canonicalizer is allowed, the reference's own 2pc
+          rule is imperfect; it weakens reduction but stays sound)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..core import Model
+from .diagnostics import AnalysisReport, Severity
+from .sampling import Sample
+
+
+def _loc(model: Model, member: str) -> str:
+    return f"{type(model).__name__}.{member}"
+
+
+def resolve_symmetry_fn(model: Model, symmetry_fn=None):
+    """The canonicalizer to lint: an explicit builder fn, the adapter's
+    representative_state, or the states' own representative() method.
+    Returns None when the model has no symmetry story (rules skip)."""
+    if symmetry_fn is not None:
+        return symmetry_fn
+    rep_state = getattr(model, "representative_state", None)
+    if rep_state is not None:
+        tm = getattr(model, "tm", None)
+        if tm is not None and tm.representative_lanes is None:
+            return None
+        return rep_state
+    try:
+        inits = model.init_states()
+    except BaseException:  # noqa: BLE001 - determinism rules report this
+        return None
+    if inits and hasattr(inits[0], "representative"):
+        return lambda s: s.representative()
+    return None
+
+
+def run(
+    model: Model,
+    sample: Sample,
+    report: AnalysisReport,
+    symmetry_fn: Optional[Callable[[Any], Any]] = None,
+    tm=None,
+    rows: Optional[np.ndarray] = None,
+    orbit_fn: Optional[Callable[[Any], List[Any]]] = None,
+) -> None:
+    fn = resolve_symmetry_fn(model, symmetry_fn)
+    if fn is None and (tm is None or tm.representative_lanes is None):
+        return  # no symmetry declared anywhere: nothing to lint
+    report.families_run.append("symmetry")
+
+    if fn is not None:
+        _check_host(model, sample, report, fn, orbit_fn)
+    if tm is not None and tm.representative_lanes is not None and rows is not None:
+        _check_lanes(tm, rows, report)
+
+
+def _check_host(model, sample, report, fn, orbit_fn) -> None:
+    try:
+        props = list(model.properties())
+    except BaseException:  # noqa: BLE001
+        props = []
+    idem_reported = False
+    prop_reported = False
+    orbit_reported = False
+    for state in sample.states:
+        try:
+            rep = fn(state)
+            rep2 = fn(rep)
+        except BaseException as e:  # noqa: BLE001
+            report.add(
+                "STR401",
+                Severity.ERROR,
+                f"representative raised {type(e).__name__} on sampled "
+                f"state {state!r}: {e}",
+                _loc(model, "representative"),
+                "canonicalization must be total over reachable states",
+            )
+            return
+        try:
+            fp_rep = model.fingerprint_state(rep)
+            fp_rep2 = model.fingerprint_state(rep2)
+        except BaseException:  # noqa: BLE001 - STR104 territory
+            continue
+        if fp_rep != fp_rep2 and not idem_reported:
+            report.add(
+                "STR402",
+                Severity.ERROR,
+                f"representative is not idempotent: rep(s)={rep!r} but "
+                f"rep(rep(s))={rep2!r} for sampled s={state!r}; the "
+                "visited set never converges on a canonical form",
+                _loc(model, "representative"),
+                "canonicalize to a fixed point (e.g. a full sort, not one "
+                "bubble pass)",
+            )
+            idem_reported = True
+        if not prop_reported:
+            for p in props:
+                try:
+                    v_raw = bool(p.condition(model, state))
+                    v_rep = bool(p.condition(model, rep))
+                except BaseException:  # noqa: BLE001 - STR302 territory
+                    continue
+                if v_raw != v_rep:
+                    report.add(
+                        "STR403",
+                        Severity.ERROR,
+                        f"property {p.name!r} is {v_raw} on state "
+                        f"{state!r} but {v_rep} on its representative "
+                        f"{rep!r}; the symmetry-reduced run would check a "
+                        "DIFFERENT property than the full run",
+                        _loc(model, "representative"),
+                        "only permute identities the properties are "
+                        "invariant under",
+                    )
+                    prop_reported = True
+                    break
+        if orbit_fn is not None and not orbit_reported:
+            try:
+                orbit = list(orbit_fn(state))
+                fps = {
+                    int(model.fingerprint_state(fn(o))) for o in orbit
+                } | {int(fp_rep)}
+            except BaseException:  # noqa: BLE001
+                continue
+            if len(fps) > 1:
+                report.add(
+                    "STR405",
+                    Severity.WARNING,
+                    f"{len(fps)} distinct representatives across one "
+                    f"symmetry orbit of {state!r}; the canonicalizer is "
+                    "imperfect (sound, but the reduction is weaker than "
+                    "the orbit count suggests)",
+                    _loc(model, "representative"),
+                    "break canonicalization ties on ALL state components, "
+                    "not just the sort key",
+                )
+                orbit_reported = True
+
+
+def _check_lanes(tm, rows: np.ndarray, report: AnalysisReport) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    S = tm.state_width
+    lanes = tuple(np.ascontiguousarray(rows[:, i]) for i in range(S))
+    try:
+        rep_np = tuple(
+            np.asarray(l, dtype=np.uint32)
+            for l in tm.representative_lanes(np, lanes)
+        )
+        rep2_np = tuple(
+            np.asarray(l, dtype=np.uint32)
+            for l in tm.representative_lanes(np, rep_np)
+        )
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR401",
+            Severity.ERROR,
+            f"representative_lanes raised under numpy: "
+            f"{type(e).__name__}: {e}",
+            f"{type(tm).__name__}.representative_lanes",
+            "the canonicalizer must be a pure batched array program",
+        )
+        return
+    for s in range(S):
+        if not np.array_equal(rep_np[s], rep2_np[s]):
+            i = int(np.nonzero(rep_np[s] != rep2_np[s])[0][0])
+            report.add(
+                "STR402",
+                Severity.ERROR,
+                f"representative_lanes is not idempotent on lane {s} "
+                f"(batch row {i}: rep={int(rep_np[s][i])} vs "
+                f"rep(rep)={int(rep2_np[s][i])}); the canonical closure "
+                "never converges",
+                f"{type(tm).__name__}.representative_lanes",
+                "run the sorting network to a full fixed point",
+            )
+            return
+
+    @jax.jit
+    def rep_j(l):
+        return tm.representative_lanes(jnp, l)
+
+    try:
+        rep_jnp = rep_j(tuple(jnp.asarray(l) for l in lanes))
+    except BaseException as e:  # noqa: BLE001
+        report.add(
+            "STR401",
+            Severity.ERROR,
+            f"representative_lanes fails under jax.jit: "
+            f"{type(e).__name__}: {str(e).splitlines()[0] if str(e) else e}",
+            f"{type(tm).__name__}.representative_lanes",
+            "remove data-dependent Python control flow; use elementwise "
+            "min/max networks",
+        )
+        return
+    for s in range(S):
+        j = np.asarray(rep_jnp[s]).astype(np.uint32)
+        if not np.array_equal(rep_np[s], j):
+            i = int(np.nonzero(rep_np[s] != j)[0][0])
+            report.add(
+                "STR404",
+                Severity.ERROR,
+                f"representative_lanes disagrees between numpy and jax on "
+                f"lane {s} (batch row {i}: {int(rep_np[s][i])} vs "
+                f"{int(j[i])}); host and device would canonicalize into "
+                "different quotients",
+                f"{type(tm).__name__}.representative_lanes",
+                "keep every operation in the shared uint32 xp subset",
+            )
+            return

@@ -49,6 +49,9 @@ class CheckerBuilder:
         self.timeout_: Optional[float] = None
         self.trace_path_: Optional[str] = None
         self.profile_dir_: Optional[str] = None
+        self.strict_: bool = False
+        self.strict_samples_: int = 128
+        self.lint_report_: Optional[Any] = None
 
     # -- options ------------------------------------------------------------
 
@@ -108,6 +111,43 @@ class CheckerBuilder:
         """Bracket the run with `jax.profiler` start/stop_trace into
         `log_dir`. A no-op when the profiler is unavailable."""
         self.profile_dir_ = log_dir
+        return self
+
+    # -- static analysis (speclint; stateright_tpu.analysis) -----------------
+
+    def lint(self, samples: int = 256) -> Any:
+        """Run the speclint pre-flight over this builder's model and
+        symmetry options WITHOUT launching an engine.
+
+        Returns an `analysis.AnalysisReport`; its diagnostic counts are
+        also exported through `Checker.telemetry()` (as ``lint_<code>``
+        counters) by any engine subsequently spawned from this builder.
+        """
+        from . import tensor as _tensor
+        from .analysis import analyze
+
+        # Tensor-backed models canonicalize via representative_lanes (the
+        # thing the device engines actually run); the host-level
+        # symmetry lambda only applies to rich host states.
+        tensorish = isinstance(
+            self.model, (_tensor.TensorModel, _tensor.TensorModelAdapter)
+        )
+        self.lint_report_ = analyze(
+            self.model,
+            samples=samples,
+            symmetry_fn=None if tensorish else self.symmetry_fn_,
+        )
+        return self.lint_report_
+
+    def strict(self, enable: bool = True, samples: int = 128) -> "CheckerBuilder":
+        """Refuse to launch ANY engine while speclint finds error-severity
+        diagnostics: every spawn_* first runs `lint()` (reusing an
+        explicit earlier `lint()` result) and raises `SpecLintError` when
+        the model's determinism, device encoding, properties, or symmetry
+        are broken — engines checking a broken spec are worse than
+        useless. `samples` bounds the pre-flight state sample."""
+        self.strict_ = enable
+        self.strict_samples_ = samples
         return self
 
     # -- engines ------------------------------------------------------------

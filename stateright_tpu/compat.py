@@ -1,0 +1,71 @@
+"""JAX version/platform compatibility shims.
+
+Two hazards live here so every call site shares one vetted answer:
+
+1. `shard_map` moved. jax >= 0.5 exposes it as `jax.shard_map`; on the
+   0.4.x line (0.4.37 in this container) it lives at
+   `jax.experimental.shard_map.shard_map`. `get_shard_map()` resolves
+   whichever exists, once, so the mesh engine imports cannot break on
+   either side of the move.
+
+2. Buffer donation is UNSOUND on XLA:CPU when the persistent compilation
+   cache is enabled (measured on jax 0.4.37, this container): an
+   executable DESERIALIZED from the cache mis-executes donated-buffer
+   while-loop programs — in the visited-set claim protocol, 8 of 2,556
+   inserted keys landed off their double-hash probe sequence, silently
+   breaking dedup (a resumed 2pc-5 run counted 28,003 "uniques" in an
+   8,832-state space). Freshly compiled executables are always correct;
+   only the cache-hit path corrupts, and only with donation. Donation
+   only matters on device backends (it keeps the 2x table/ring footprint
+   out of HBM); on CPU the arrays are host RAM and the copy is cheap.
+   `donate_argnums_safe(...)` therefore returns the requested argnums on
+   TPU/GPU backends and `()` on CPU, keeping the persistent cache (which
+   CI relies on for compile wall-clock) sound.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def get_shard_map():
+    """The `shard_map` transform for the installed jax version.
+
+    jax >= 0.5: `jax.shard_map`; jax 0.4.x: `jax.experimental.shard_map`.
+    The 0.4.x implementation has no replication rule for `lax.while_loop`
+    (the shape of every per-shard era loop here) and must be told to skip
+    that static check, so when the resolved transform accepts `check_rep`
+    it is pinned False; newer jax dropped the parameter along with the
+    limitation.
+    """
+    import inspect
+
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):  # builtins without introspectable sigs
+        return sm
+    if "check_rep" in params:
+        import functools
+
+        return functools.partial(sm, check_rep=False)
+    return sm
+
+
+def donate_argnums_safe(*argnums: int) -> Tuple[int, ...]:
+    """`argnums` on device backends, `()` on CPU.
+
+    See the module docstring: deserialized persistent-cache executables
+    corrupt donated buffers on XLA:CPU, so donation is only requested
+    where it pays (device HBM) and is known sound.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return ()
+    return tuple(argnums)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
@@ -58,6 +58,11 @@ class HostEngineBase(Checker):
         # Checker.telemetry() for every engine; an optional JSONL trace
         # stream and jax.profiler bracket ride the builder options.
         self._metrics = MetricsRegistry()
+        # Speclint pre-flight (stateright_tpu.analysis): in strict mode the
+        # engine refuses to launch over error-severity findings; whenever a
+        # report exists (strict auto-run or an explicit builder.lint()),
+        # its diagnostic counts ride the metrics registry into telemetry.
+        self._lint_preflight(builder)
         trace_path = getattr(builder, "trace_path_", None)
         self._trace: Optional[TraceWriter] = (
             TraceWriter(trace_path, engine=type(self).__name__)
@@ -84,6 +89,21 @@ class HostEngineBase(Checker):
         # Pre-run snapshot for deterministic first "Checking." report lines;
         # engines refresh it after seeding counts, before starting the thread.
         self._initial_snapshot = (0, 0, 0)
+
+    def _lint_preflight(self, builder: CheckerBuilder) -> None:
+        report = getattr(builder, "lint_report_", None)
+        if getattr(builder, "strict_", False) and report is None:
+            report = builder.lint(samples=getattr(builder, "strict_samples_", 128))
+        if report is None:
+            return
+        for code, n in report.counts_by_code().items():
+            self._metrics.inc(f"lint_{code}", n)
+        self._metrics.set_gauge("lint_errors", len(report.errors))
+        self._metrics.set_gauge("lint_warnings", len(report.warnings))
+        if getattr(builder, "strict_", False) and not report.ok:
+            from ..analysis import SpecLintError
+
+            raise SpecLintError(report)
 
     # -- lifecycle ----------------------------------------------------------
 

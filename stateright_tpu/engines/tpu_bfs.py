@@ -139,6 +139,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     import jax.numpy as jnp
     from jax import lax
 
+    from ..compat import donate_argnums_safe
     from ..fingerprint import hash_lanes_jnp
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
@@ -160,7 +161,9 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     # enough to be cache-hot.
     dedup_cap = 1 << max(1, (4 * vcap - 1).bit_length())
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    # Table and ring donate on device backends only — donation under the
+    # CPU persistent compilation cache miscompiles (compat docstring).
+    @functools.partial(jax.jit, donate_argnums=donate_argnums_safe(0, 1))
     def loop(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
         head0 = params[P_HEAD]

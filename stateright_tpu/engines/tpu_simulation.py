@@ -46,7 +46,7 @@ from __future__ import annotations
 import functools
 import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
@@ -118,7 +118,9 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         x = (x ^ (x >> u(15))) * u(0x846CA68B)
         return x ^ (x >> u(16))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    from ..compat import donate_argnums_safe
+
+    @functools.partial(jax.jit, donate_argnums=donate_argnums_safe(0, 1, 2))
     def loop(walk, fp1buf, fp2buf, params):
         """walk = (rows[S], seed, ptr, ebits, frozen) lanes of [B];
         fp*buf = [B * L] flat path buffers. The frozen lane MUST cross the

@@ -24,6 +24,10 @@ Counters (`inc`) — monotonic totals:
   ``refill_rows``        frontier rows refilled host -> device
   ``table_growths``      visited-table doublings (grow + rehash)
   ``expand_requests``    on-demand fingerprint expansions served
+  ``lint_<CODE>``        speclint diagnostics by stable code (e.g.
+                         ``lint_STR303``) when the run was linted — strict
+                         mode or an explicit `CheckerBuilder.lint()`
+                         (catalog: analysis/README.md)
   =====================  =====================================================
 
 Gauges (`set_gauge`) — last-observed values:
@@ -40,6 +44,8 @@ Gauges (`set_gauge`) — last-observed values:
   ``walks`` / ``walk_cap`` simulation batch width / path-buffer depth
   ``threads`` / ``workers``  host parallelism actually used
   ``n_shards`` / ``quota``   mesh engine shard count / exchange quota
+  ``lint_errors`` / ``lint_warnings``  speclint finding counts by severity
+                           (linted runs only)
   =======================  ===================================================
 
 Phase timers (`phase(name)` context manager / `add_phase`) — cumulative

@@ -387,9 +387,13 @@ def rehash(old_table, new_table):
 # embedded array constants, which this platform dispatches on a ~100ms
 # degraded path (and the degradation sticks for the whole process). Under jit
 # the operands are tracers and the programs stay on the fast path.
-insert_jit = jax.jit(insert, donate_argnums=(0,))
+# Donation is gated off on CPU: persistent-cache-deserialized executables
+# corrupt donated buffers there (stateright_tpu.compat docstring).
+from ..compat import donate_argnums_safe as _donate
+
+insert_jit = jax.jit(insert, donate_argnums=_donate(0))
 lookup_parent_jit = jax.jit(lookup_parent)
-rehash_jit = jax.jit(rehash, donate_argnums=(1,))
+rehash_jit = jax.jit(rehash, donate_argnums=_donate(1))
 
 
 def lookup_parent_np(table_np, h1: int, h2: int):

@@ -33,7 +33,6 @@ on the host by walking parent pointers across the downloaded table shards
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -82,9 +81,10 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
 
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec
 
+    from ..compat import donate_argnums_safe, get_shard_map
     from ..engines.tpu_bfs import _vcap
     from ..fingerprint import hash_lanes_jnp
     from ..ops import frontier as fr
@@ -392,13 +392,13 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
 
     spec = PartitionSpec(axis)
     block = jax.jit(
-        shard_map(
+        get_shard_map()(
             per_device,
             mesh=mesh,
             in_specs=(spec,) * 5,
             out_specs=(spec,) * 6,
         ),
-        donate_argnums=(0, 1),
+        donate_argnums=donate_argnums_safe(0, 1),
     )
     _LOOP_CACHE[key] = (tm, block)
     return block
@@ -421,9 +421,9 @@ def _build_grow(old_cap: int, new_cap: int, mesh, axis: str):
         _GROW_CACHE.pop(next(iter(_GROW_CACHE)))
 
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec
 
+    from ..compat import donate_argnums_safe, get_shard_map
     from ..ops import visited_set as vs
 
     def per_device(table):
@@ -443,13 +443,13 @@ def _build_grow(old_cap: int, new_cap: int, mesh, axis: str):
 
     spec = PartitionSpec(axis)
     grow = jax.jit(
-        shard_map(
+        get_shard_map()(
             per_device,
             mesh=mesh,
             in_specs=((spec,) * 4,),
             out_specs=((spec,) * 4, spec),
         ),
-        donate_argnums=(0,),
+        donate_argnums=donate_argnums_safe(0),
     )
 
     def run(table):

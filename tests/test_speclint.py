@@ -1,0 +1,505 @@
+"""speclint (stateright_tpu.analysis): each rule family must flag its
+deliberately broken model, and every bundled example model must lint
+clean (the dogfood test the CI contract hangs off)."""
+
+from __future__ import annotations
+
+import random
+from typing import List
+
+import numpy as np
+import pytest
+
+from stateright_tpu import SpecLintError, analyze
+from stateright_tpu.analysis import AnalysisReport, Severity
+from stateright_tpu.core import Model, Property
+from stateright_tpu.tensor import TensorModel, TensorModelAdapter, TensorProperty
+
+
+def codes(report: AnalysisReport) -> set:
+    return {d.code for d in report.diagnostics}
+
+
+def error_codes(report: AnalysisReport) -> set:
+    return {d.code for d in report.errors}
+
+
+# ---------------------------------------------------------------------------
+# Broken-model fixtures, one per rule family.
+# ---------------------------------------------------------------------------
+
+
+class RngActionsModel(Model):
+    """STR101: hidden RNG in `actions` (the classic corruption source)."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions: List) -> None:
+        actions.append(random.randint(0, 1 << 30))
+
+    def next_state(self, state, action):
+        return (state + action) % 97 if state < 50 else None
+
+    def properties(self):
+        return [Property.always("true", lambda _m, _s: True)]
+
+
+class MutatingModel(Model):
+    """STR103: `next_state` edits its input state in place."""
+
+    def init_states(self):
+        return [[0, 0]]
+
+    def actions(self, state, actions: List) -> None:
+        if state[0] < 3:
+            actions.append(1)
+
+    def next_state(self, state, action):
+        state[0] += action  # the bug: successor built by editing the input
+        return [state[0], state[1]]
+
+    def properties(self):
+        return [Property.always("true", lambda _m, _s: True)]
+
+
+class RngNextStateModel(Model):
+    """STR102: `next_state` flips a hidden coin."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions: List) -> None:
+        if state < 5:
+            actions.append("go")
+
+    def next_state(self, state, action):
+        return state + random.choice([1, 2])
+
+    def properties(self):
+        return []
+
+
+class UnfingerprintableModel(Model):
+    """STR104: states the canonical serializer cannot encode."""
+
+    class Opaque:
+        pass
+
+    def init_states(self):
+        return [self.Opaque()]
+
+    def actions(self, state, actions: List) -> None:
+        pass
+
+    def next_state(self, state, action):
+        return None
+
+
+class OverflowPackTensor(TensorModel):
+    """STR207: successor values overflow the uint32 lane packing (numpy
+    promotes to int64 and keeps the wide value; the device engine's cast
+    would silently truncate)."""
+
+    state_width = 1
+    max_actions = 1
+
+    def init_states_array(self) -> np.ndarray:
+        return np.asarray([[0x90000000]], dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        # The bug: arithmetic in a wide off-lane dtype; the wide values
+        # exceed the uint32 packing and the device cast truncates them.
+        nxt = lanes[0].astype(xp.int64) * 3 + 1
+        return [(nxt,)], [lanes[0] >= 0]
+
+    def tensor_properties(self):
+        return [TensorProperty.always("true", lambda xp, l: l[0] == l[0])]
+
+
+class UntraceableTensor(TensorModel):
+    """STR201: data-dependent Python control flow in `step_lanes`."""
+
+    state_width = 1
+    max_actions = 1
+
+    def init_states_array(self) -> np.ndarray:
+        return np.zeros((1, 1), dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        u = xp.uint32
+        if lanes[0][0] > 5:  # the bug: concrete branch on a traced value
+            nxt = lanes[0] - u(1)
+        else:
+            nxt = lanes[0] + u(1)
+        return [(nxt,)], [lanes[0] < u(10)]
+
+    def tensor_properties(self):
+        return [TensorProperty.always("true", lambda xp, l: l[0] == l[0])]
+
+
+class BadMaskTensor(TensorModel):
+    """STR202: validity masks with the wrong dtype/shape."""
+
+    state_width = 1
+    max_actions = 1
+
+    def init_states_array(self) -> np.ndarray:
+        return np.zeros((1, 1), dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        u = xp.uint32
+        nxt = (lanes[0] + u(1)) & u(7)
+        return [(nxt,)], [(lanes[0] < u(8)).astype(xp.uint32)]  # not bool
+
+    def tensor_properties(self):
+        return []
+
+
+class BadDecodeTensor(TensorModel):
+    """STR204: `decode_state` crashes on reachable rows."""
+
+    state_width = 1
+    max_actions = 1
+
+    def init_states_array(self) -> np.ndarray:
+        return np.zeros((1, 1), dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        u = xp.uint32
+        return [((lanes[0] + u(1)) & u(3),)], [lanes[0] == lanes[0]]
+
+    def tensor_properties(self):
+        return []
+
+    def decode_state(self, row):
+        return {0: "zero"}[int(row[0])]  # KeyError beyond the first row
+
+
+class DupPropsModel(Model):
+    """STR301: two properties sharing one name shadow each other."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions: List) -> None:
+        if state < 3:
+            actions.append(1)
+
+    def next_state(self, state, action):
+        return state + action
+
+    def properties(self):
+        return [
+            Property.always("safe", lambda _m, s: s < 10),
+            Property.sometimes("safe", lambda _m, s: s > 1),
+        ]
+
+
+class RaisingPropModel(Model):
+    """STR302: a predicate that raises mid-search."""
+
+    def init_states(self):
+        return [0]
+
+    def actions(self, state, actions: List) -> None:
+        if state < 5:
+            actions.append(1)
+
+    def next_state(self, state, action):
+        return state + action
+
+    def properties(self):
+        return [Property.always("broken", lambda _m, s: 1 // max(0, 2 - s) >= 0)]
+
+
+class NonIdempotentRepState:
+    """rep() rotates instead of sorting: rep(rep(s)) != rep(s)."""
+
+    def __init__(self, items):
+        self.items = tuple(items)
+
+    def representative(self) -> "NonIdempotentRepState":
+        return NonIdempotentRepState(self.items[1:] + self.items[:1])
+
+    def fingerprint_key(self):
+        return self.items
+
+    def __repr__(self):
+        return f"S{self.items}"
+
+
+class NonIdempotentRepModel(Model):
+    """STR402: canonicalization that never reaches a fixed point."""
+
+    def init_states(self):
+        return [NonIdempotentRepState((2, 0, 1))]
+
+    def actions(self, state, actions: List) -> None:
+        pass
+
+    def next_state(self, state, action):
+        return None
+
+    def properties(self):
+        return [Property.always("true", lambda _m, _s: True)]
+
+
+class PropChangingRepState:
+    def __init__(self, x):
+        self.x = x
+
+    def representative(self):
+        return PropChangingRepState(0)  # collapses EVERYTHING to one class
+
+    def fingerprint_key(self):
+        return self.x
+
+    def __repr__(self):
+        return f"P({self.x})"
+
+
+class PropChangingRepModel(Model):
+    """STR403: the 'representative' changes property verdicts."""
+
+    def init_states(self):
+        return [PropChangingRepState(1)]
+
+    def actions(self, state, actions: List) -> None:
+        if state.x < 4:
+            actions.append(1)
+
+    def next_state(self, state, action):
+        return PropChangingRepState(state.x + action)
+
+    def properties(self):
+        return [Property.always("positive", lambda _m, s: s.x > 0)]
+
+
+class DivergentRepTensor(TensorModel):
+    """STR404: representative_lanes differs between numpy and jax
+    (int64 promotion under numpy vs uint32 wraparound under jax)."""
+
+    state_width = 1
+    max_actions = 1
+
+    def init_states_array(self) -> np.ndarray:
+        return np.asarray([[0xF0000000]], dtype=np.uint32)
+
+    def step_lanes(self, xp, lanes):
+        u = xp.uint32
+        return [((lanes[0] ^ u(1)),)], [lanes[0] == lanes[0]]
+
+    def tensor_properties(self):
+        return []
+
+    def representative_lanes(self, xp, lanes):
+        # Wide-dtype canonicalization: numpy int64 keeps the full product,
+        # jax (x64 disabled) truncates to int32 — host and device
+        # canonicalize into different quotients.
+        wide = lanes[0].astype(xp.int64)
+        return (((wide * 5) % 4093).astype(xp.uint32),)
+
+
+# ---------------------------------------------------------------------------
+# Family 1: determinism / purity
+# ---------------------------------------------------------------------------
+
+
+def test_rng_in_actions_flagged():
+    report = analyze(RngActionsModel())
+    assert "STR101" in error_codes(report)
+
+
+def test_mutating_next_state_flagged():
+    report = analyze(MutatingModel())
+    assert "STR103" in error_codes(report)
+
+
+def test_rng_in_next_state_flagged():
+    report = analyze(RngNextStateModel())
+    assert error_codes(report) & {"STR102", "STR101"}
+
+
+def test_unfingerprintable_state_flagged():
+    report = analyze(UnfingerprintableModel())
+    assert "STR104" in error_codes(report)
+
+
+# ---------------------------------------------------------------------------
+# Family 2: device compatibility
+# ---------------------------------------------------------------------------
+
+
+def test_overflowing_field_pack_flagged():
+    report = analyze(OverflowPackTensor())
+    assert "STR207" in error_codes(report)
+
+
+def test_untraceable_step_lanes_flagged():
+    report = analyze(UntraceableTensor())
+    assert "STR201" in error_codes(report)
+
+
+def test_bad_mask_dtype_flagged():
+    report = analyze(BadMaskTensor())
+    assert "STR202" in error_codes(report)
+
+
+def test_bad_decode_state_flagged():
+    report = analyze(BadDecodeTensor())
+    assert "STR204" in error_codes(report)
+
+
+# ---------------------------------------------------------------------------
+# Family 3: property well-formedness
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_property_names_flagged():
+    report = analyze(DupPropsModel())
+    assert "STR301" in error_codes(report)
+
+
+def test_raising_predicate_flagged():
+    report = analyze(RaisingPropModel())
+    assert "STR302" in error_codes(report)
+
+
+def test_no_properties_warns():
+    class NoProps(Model):
+        def init_states(self):
+            return [0]
+
+        def actions(self, state, actions):
+            pass
+
+        def next_state(self, state, action):
+            return None
+
+    report = analyze(NoProps())
+    assert "STR305" in codes(report)
+    assert report.ok  # warning, not error
+
+
+# ---------------------------------------------------------------------------
+# Family 4: symmetry soundness
+# ---------------------------------------------------------------------------
+
+
+def test_non_idempotent_representative_flagged():
+    report = analyze(NonIdempotentRepModel())
+    assert "STR402" in error_codes(report)
+
+
+def test_property_changing_representative_flagged():
+    report = analyze(PropChangingRepModel())
+    assert "STR403" in error_codes(report)
+
+
+def test_divergent_representative_lanes_flagged():
+    report = analyze(DivergentRepTensor())
+    assert error_codes(report) & {"STR404", "STR402"}
+
+
+# ---------------------------------------------------------------------------
+# Dogfood: every bundled example model lints clean (zero errors).
+# ---------------------------------------------------------------------------
+
+BUNDLED_MODELS = [
+    pytest.param(lambda: __import__("stateright_tpu.models", fromlist=[n]).__dict__[n](*args), id=f"{n}{args}")
+    for n, args in [
+        ("Increment", (2,)),
+        ("IncrementTensor", (2,)),
+        ("IncrementLock", (2,)),
+        ("IncrementLockTensor", (2,)),
+        ("TwoPhaseSys", (3,)),
+        ("TwoPhaseTensor", (3,)),
+        ("AbdTensor", (2,)),
+        ("AbdOrderedTensor", (2,)),
+        ("PaxosTensor", (2,)),
+        ("SingleCopyTensor", (2, 1)),
+    ]
+]
+
+
+@pytest.mark.parametrize("mk", BUNDLED_MODELS)
+def test_bundled_models_lint_clean(mk):
+    model = mk()
+    report = analyze(model, samples=96)
+    assert report.ok, report.format()
+
+
+# ---------------------------------------------------------------------------
+# Wire-in: builder.lint / strict mode / telemetry / CLI
+# ---------------------------------------------------------------------------
+
+
+def test_builder_lint_and_telemetry():
+    from stateright_tpu.models import IncrementTensor
+
+    builder = TensorModelAdapter(IncrementTensor(2)).checker()
+    report = builder.lint(samples=64)
+    assert report.ok
+    checker = builder.spawn_bfs().join()
+    t = checker.telemetry()
+    assert t["lint_errors"] == 0
+    assert checker.unique_state_count() == 13
+
+
+def test_strict_mode_refuses_broken_model():
+    with pytest.raises(SpecLintError) as exc:
+        RngActionsModel().checker().strict().spawn_bfs()
+    assert "STR101" in str(exc.value)
+
+
+def test_strict_mode_launches_clean_model():
+    from stateright_tpu.models import IncrementTensor
+
+    checker = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .strict()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 13
+    assert checker.telemetry()["lint_errors"] == 0
+
+
+def test_strict_mode_refuses_device_engine_launch():
+    """The pre-flight guards the DEVICE engines too (that is its point:
+    a shape bug otherwise surfaces inside a jitted program)."""
+    adapter = TensorModelAdapter(OverflowPackTensor())
+    with pytest.raises(SpecLintError):
+        adapter.checker().strict().spawn_tpu_bfs(
+            chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 10
+        )
+
+
+def test_cli_main_clean_and_broken(capsys):
+    from stateright_tpu.analysis.__main__ import main
+
+    assert main(["increment:2", "--samples", "64"]) == 0
+    out = capsys.readouterr().out
+    assert "IncrementTensor" in out
+
+    assert main(["tests.test_speclint:DupPropsModel", "--json"]) == 1
+    out = capsys.readouterr().out
+    assert "STR301" in out
+
+
+def test_report_format_and_dict_round_trip():
+    report = analyze(DupPropsModel())
+    d = report.to_dict()
+    assert d["ok"] is False
+    assert d["counts_by_code"].get("STR301", 0) >= 1
+    assert "STR301" in report.format()
+    assert any(x["severity"] == "error" for x in d["diagnostics"])
+
+
+def test_severity_partition():
+    report = analyze(MutatingModel())
+    assert not report.ok
+    for d in report.errors:
+        assert d.severity is Severity.ERROR
