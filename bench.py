@@ -77,7 +77,117 @@ def timed3(mk_checker, golden=None, check=None):
     return statistics.median(secs), (min(secs), max(secs)), last
 
 
+# -- BENCH json comparison (`python bench.py --compare A.json B.json`) --------
+
+
+def _flatten_metrics(prefix, obj, out):
+    """Dotted-path -> numeric value for every number in a BENCH record
+    (bool excluded: golden_match deltas are not metrics)."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten_metrics(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+
+
+def _load_bench(path):
+    """Last parseable JSON line of a BENCH file (bench re-emits the line
+    as sections land; the last one is the most complete refinement).
+
+    Accepts both raw bench stdout AND the driver's BENCH_rN.json wrapper,
+    whose ``tail`` field holds the captured stdout — so
+    ``--compare BENCH_r04.json BENCH_r05.json`` works on round artifacts
+    as committed.
+    """
+    last = None
+    with open(path) as f:
+        text = f.read()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(record, dict):
+            last = record
+    if last is None:
+        # Not line-oriented: try the whole file as one (pretty-printed)
+        # JSON document.
+        try:
+            record = json.loads(text)
+        except json.JSONDecodeError:
+            raise SystemExit(f"{path}: no JSON record found")
+        if not isinstance(record, dict):
+            raise SystemExit(f"{path}: no JSON record found")
+        last = record
+    if "metric" not in last and isinstance(last.get("tail"), str):
+        inner = None
+        for line in last["tail"].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(record, dict):
+                inner = record
+        if inner is not None:
+            last = inner
+    return last
+
+
+def compare_bench(path_a, path_b, out=None):
+    """Per-metric/per-phase delta table between two BENCH json files.
+
+    Makes regressions diagnosable from phase breakdowns instead of
+    eyeballing JSON: every numeric leaf (rates, secs, telemetry counters,
+    phase_ms entries, coverage counts) becomes one row with both values
+    and the relative delta, sorted by path.
+    """
+    out = out if out is not None else sys.stdout
+    a, b = _load_bench(path_a), _load_bench(path_b)
+    fa, fb = {}, {}
+    _flatten_metrics("", a, fa)
+    _flatten_metrics("", b, fb)
+    keys = sorted(set(fa) | set(fb))
+    name_w = max((len(k) for k in keys), default=6)
+    out.write(
+        f"{'metric':<{name_w}}  {path_a:>14}  {path_b:>14}  {'delta':>12}  {'pct':>8}\n"
+    )
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        return f"{v:.3f}".rstrip("0").rstrip(".") if v % 1 else f"{int(v)}"
+
+    for k in keys:
+        va, vb = fa.get(k), fb.get(k)
+        if va is None or vb is None:
+            delta = pct = "-"
+        else:
+            delta = fmt(vb - va)
+            pct = f"{(vb - va) / va * 100.0:+.1f}%" if va else "-"
+        out.write(
+            f"{k:<{name_w}}  {fmt(va):>14}  {fmt(vb):>14}  {delta:>12}  {pct:>8}\n"
+        )
+    return 0
+
+
 def main() -> None:
+    if "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        try:
+            path_a, path_b = sys.argv[i + 1 : i + 3]
+        except ValueError:
+            print("usage: python bench.py --compare BENCH_rA.json BENCH_rB.json")
+            return 2
+        return compare_bench(path_a, path_b)
+
     import os
 
     import jax
@@ -194,6 +304,7 @@ def main() -> None:
         golden=tpc7_golden,
     )
     dev_rate = dev7.state_count() / med7
+    cov7 = dev7.coverage()
     detail["tpc7"] = {
         "states_per_sec": round(dev_rate, 1),
         "unique": dev7.unique_state_count(),
@@ -201,6 +312,29 @@ def main() -> None:
         "secs_spread": [round(s, 3) for s in spread7],
         "golden_match": True,
         "telemetry": dev7.telemetry(),
+        "coverage": cov7,
+    }
+    assert not cov7["dead_actions"], cov7["dead_actions"]
+    assert sum(cov7["depths"].values()) == dev7.unique_state_count()
+
+    # Coverage cost: the same workload with .coverage(False) — the era
+    # loop compiles WITHOUT the in-carry histograms. Both rates land in
+    # BENCH json (acceptance: enabling coverage costs < 5%).
+    TensorModelAdapter(tm7).checker().coverage(False).spawn_tpu_bfs(
+        **opts
+    ).join()  # compile
+    med7off, _spread7off, dev7off = timed3(
+        lambda: (
+            TensorModelAdapter(tm7).checker().coverage(False)
+            .spawn_tpu_bfs(**opts)
+        ),
+        golden=tpc7_golden,
+    )
+    rate_off = dev7off.state_count() / med7off
+    detail["tpc7_coverage_cost"] = {
+        "states_per_sec_coverage_on": round(dev_rate, 1),
+        "states_per_sec_coverage_off": round(rate_off, 1),
+        "overhead_pct": round((1.0 - dev_rate / rate_off) * 100.0, 2),
     }
     vs_threaded = dev_rate / host_threaded_rate if host_threaded_rate else 0.0
     detail["vs_host_single"] = round(

@@ -18,6 +18,20 @@ from stateright_tpu import WriteReporter
 from stateright_tpu.actor import Network
 
 
+def print_coverage(checker) -> None:
+    """Compact per-action coverage table after a check run (the detailed
+    dead-action warning block already rides WriteReporter's summary)."""
+    cov = checker.coverage()
+    actions = cov.get("actions") or {}
+    if not cov.get("enabled") or not actions:
+        return
+    width = max(len(label) for label in actions)
+    print("Action coverage (fire counts):")
+    for label, count in actions.items():
+        marker = "" if count else "   <- never fired"
+        print(f"  {label:<{width}}  {count}{marker}")
+
+
 def example_main(
     argv,
     name: str,
@@ -45,6 +59,7 @@ def example_main(
         else:
             checker = builder.spawn_bfs()
         checker.report(WriteReporter(sys.stdout))
+        print_coverage(checker)
     elif subcommand == "lint":
         from stateright_tpu.analysis import analyze
 

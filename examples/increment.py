@@ -27,18 +27,22 @@ def main(argv=None):
     subcommand = argv[0] if argv else "check"
     thread_count = int(argv[1]) if len(argv) > 1 else 2
     print(f"Model checking increment with {thread_count} threads.")
+    from examples._cli import print_coverage
+
     if subcommand == "check":
-        Increment(thread_count).checker().spawn_dfs().report(
+        checker = Increment(thread_count).checker().spawn_dfs().report(
             WriteReporter(sys.stdout)
         )
+        print_coverage(checker)
     elif subcommand == "check-sym":
         Increment(thread_count).checker().symmetry().spawn_dfs().report(
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-tpu":
-        IncrementTensor(thread_count).checker().spawn_tpu_bfs().report(
+        checker = IncrementTensor(thread_count).checker().spawn_tpu_bfs().report(
             WriteReporter(sys.stdout)
         )
+        print_coverage(checker)
     elif subcommand == "lint":
         from stateright_tpu.analysis import analyze
 

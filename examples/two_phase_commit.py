@@ -32,11 +32,14 @@ def main(argv=None):
         return argv[1 + i] if len(argv) > 1 + i else default
 
     rm_count = int(arg(0, 3))
+    from examples._cli import print_coverage
+
     if subcommand == "check":
         print(f"Model checking two phase commit with {rm_count} resource managers.")
-        TwoPhaseSys(rm_count).checker().spawn_bfs().report(
+        checker = TwoPhaseSys(rm_count).checker().spawn_bfs().report(
             WriteReporter(sys.stdout)
         )
+        print_coverage(checker)
     elif subcommand == "check-sym":
         print(
             f"Model checking two phase commit with {rm_count} resource managers "
@@ -50,9 +53,10 @@ def main(argv=None):
             f"Model checking two phase commit with {rm_count} resource managers "
             "on the batched TPU engine."
         )
-        TwoPhaseTensor(rm_count).checker().spawn_tpu_bfs().report(
+        checker = TwoPhaseTensor(rm_count).checker().spawn_tpu_bfs().report(
             WriteReporter(sys.stdout)
         )
+        print_coverage(checker)
     elif subcommand == "lint":
         from stateright_tpu.analysis import analyze
 

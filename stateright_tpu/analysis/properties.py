@@ -17,6 +17,13 @@ Codes:
           (warning when the sample exhausted the space: counterexamples
           are impossible by construction)
   STR305  the model declares no properties at all (warning)
+  STR306  an action slot is never enabled on any sampled state (warning
+          when the sample exhausted the space: the action is DEAD — a
+          mis-modeled guard or unreachable transition; the run verifies
+          a smaller system than the one modeled). Static twin of the
+          runtime dead-action detection in obs/coverage.py; only models
+          with a statically known action universe (TensorModels) are
+          checked.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ def _loc(model: Model, prop: Property) -> str:
 
 def run(model: Model, sample: Sample, report: AnalysisReport) -> None:
     report.families_run.append("properties")
+    _check_dead_actions(model, sample, report)
     try:
         props = list(model.properties())
     except BaseException as e:  # noqa: BLE001
@@ -98,6 +106,61 @@ def run(model: Model, sample: Sample, report: AnalysisReport) -> None:
 
     for p in seen.values():
         _check_predicate(model, p, sample, report)
+
+
+def _check_dead_actions(
+    model: Model, sample: Sample, report: AnalysisReport
+) -> None:
+    """STR306: action slots never enabled across the sampled space.
+
+    Only models with a statically known action universe (TensorModels,
+    whose actions are the `max_actions` index slots) can be checked —
+    a rich model's action space is not enumerable without running it.
+    """
+    from ..tensor import TensorModelAdapter
+
+    if not isinstance(model, TensorModelAdapter) or not sample.states:
+        return
+    tm = model.tm
+    n_actions = tm.max_actions
+    fired: set = set()
+    for state in sample.states:
+        try:
+            acts: List[int] = []
+            model.actions(state, acts)
+        except BaseException:  # noqa: BLE001 - reported by STR1xx rules
+            return
+        fired.update(acts)
+        if len(fired) == n_actions:
+            return
+    dead = [a for a in range(n_actions) if a not in fired]
+    if not dead:
+        return
+    labels = ", ".join(tm.format_action(a) for a in dead)
+    if sample.exhausted:
+        report.add(
+            "STR306",
+            Severity.WARNING,
+            f"action slot(s) {labels} are never enabled on ANY reachable "
+            "state (the sample exhausted the space): dead transitions or "
+            "mis-modeled guards — the checker verifies a smaller system "
+            "than the one modeled",
+            f"{type(tm).__name__}.step_lanes",
+            "fix the guard, or remove the action slot if the transition "
+            "is intentionally impossible",
+            dead_actions=[int(a) for a in dead],
+        )
+    else:
+        report.add(
+            "STR306",
+            Severity.INFO,
+            f"action slot(s) {labels} never enabled within the "
+            f"{len(sample.states)}-state sample (may still fire deeper); "
+            "run-time coverage (Checker.coverage) settles it",
+            f"{type(tm).__name__}.step_lanes",
+            "",
+            dead_actions=[int(a) for a in dead],
+        )
 
 
 def _check_predicate(

@@ -48,7 +48,9 @@ class CheckerBuilder:
         self.finish_when_: HasDiscoveries = HasDiscoveries.ALL
         self.timeout_: Optional[float] = None
         self.trace_path_: Optional[str] = None
+        self.trace_format_: str = "jsonl"
         self.profile_dir_: Optional[str] = None
+        self.coverage_: bool = True
         self.strict_: bool = False
         self.strict_samples_: int = 128
         self.lint_report_: Optional[Any] = None
@@ -101,10 +103,31 @@ class CheckerBuilder:
         self.timeout_ = seconds
         return self
 
-    def trace(self, path: str) -> "CheckerBuilder":
-        """Stream one JSONL event per era/wave/round to `path` (obs/trace.py
-        documents the event schema). Works with every engine."""
+    def trace(self, path: str, format: str = "jsonl") -> "CheckerBuilder":
+        """Stream one event per era/wave/round to `path` (obs/trace.py
+        documents the event schema). Works with every engine.
+        `format="jsonl"` (default) writes standalone JSON lines;
+        `format="chrome"` writes Chrome trace-event JSON loadable in
+        Perfetto / `chrome://tracing` (phase timers as duration events,
+        eras/waves as instant events)."""
+        from .obs.trace import TRACE_FORMATS
+
+        if format not in TRACE_FORMATS:
+            raise ValueError(
+                f"unknown trace format {format!r}; available: {TRACE_FORMATS}"
+            )
         self.trace_path_ = path
+        self.trace_format_ = format
+        return self
+
+    def coverage(self, enable: bool = True) -> "CheckerBuilder":
+        """Toggle coverage accounting (obs/coverage.py): per-action fire
+        counts, the per-depth unique-state histogram, per-property
+        evaluation/hit counts, and dead-action detection, surfaced via
+        `Checker.coverage()`. On by default; device engines fold the
+        histograms into their era loops, so disabling buys back only a
+        few percent of throughput (bench.py records both numbers)."""
+        self.coverage_ = enable
         return self
 
     def profile(self, log_dir: str) -> "CheckerBuilder":
@@ -272,6 +295,14 @@ class Checker:
         without STPU_DEBUG."""
         return {}
 
+    def coverage(self) -> Dict[str, Any]:
+        """The engine's coverage snapshot (obs/coverage.py): per-action
+        fire counts (`actions`), registered-but-never-fired actions
+        (`dead_actions`), the per-depth unique-state histogram
+        (`depths`), and per-property evaluation/hit counts
+        (`properties`). Engines without coverage support return {}."""
+        return {}
+
     # -- on-demand engine hooks (no-ops elsewhere; checker.rs:298-306) ------
 
     def check_fingerprint(self, fingerprint: int) -> None:
@@ -332,6 +363,7 @@ class Checker:
                 duration_secs=time.monotonic() - start,
                 done=True,
                 telemetry=self.telemetry(),
+                coverage=self.coverage(),
             )
         )
         discoveries = {

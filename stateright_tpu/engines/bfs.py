@@ -30,6 +30,7 @@ class BfsChecker(HostEngineBase):
         self._generated: Dict[int, Optional[int]] = {}
         for s in init_states:
             self._generated.setdefault(self._fp(s), None)
+        self._coverage.record_depth(1, len(self._generated))
         # job: (state, fingerprint, ebits, depth) (bfs.rs:33)
         self._pending = deque(
             (s, self._fp(s), self._init_ebits, 1) for s in init_states
@@ -83,6 +84,7 @@ class BfsChecker(HostEngineBase):
                 return  # discoveries found for all properties (bfs.rs:278-280)
 
             # Expand successors.
+            cov = self._coverage if self._coverage.enabled else None
             is_terminal = True
             actions: list = []
             model.actions(state, actions)
@@ -93,6 +95,8 @@ class BfsChecker(HostEngineBase):
                 if not model.within_boundary(next_state):
                     continue
                 self._state_count += 1
+                if cov is not None:
+                    cov.record_action(self._action_label(action))
                 next_fp = self._fp(next_state)
                 if next_fp in generated:
                     # Revisit: could be a cycle or a DAG join; treated as
@@ -100,6 +104,8 @@ class BfsChecker(HostEngineBase):
                     is_terminal = False
                     continue
                 generated[next_fp] = state_fp
+                if cov is not None:
+                    cov.record_depth(depth + 1)
                 is_terminal = False
                 pending.appendleft((next_state, next_fp, ebits, depth + 1))
             if is_terminal:

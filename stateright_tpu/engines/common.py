@@ -16,8 +16,9 @@ from typing import Any, Dict, Optional
 
 from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
+from ..obs.coverage import Coverage
 from ..obs.metrics import MetricsRegistry
-from ..obs.trace import TraceWriter, start_profile, stop_profile
+from ..obs.trace import make_trace_writer, start_profile, stop_profile
 
 BLOCK_SIZE = 1500  # states per finish_when re-check; reference bfs.rs:130
 
@@ -63,9 +64,27 @@ class HostEngineBase(Checker):
         # report exists (strict auto-run or an explicit builder.lint()),
         # its diagnostic counts ride the metrics registry into telemetry.
         self._lint_preflight(builder)
+        # Coverage accumulator (obs/coverage.py): per-action fire counts,
+        # per-depth unique-state histogram, per-property eval/hit counts,
+        # and dead-action detection — populated by every engine, surfaced
+        # via Checker.coverage(). Tensor-backed models register their full
+        # action universe up front (that is what makes a zero count a DEAD
+        # action rather than merely an unobserved one).
+        self._coverage = Coverage(enabled=getattr(builder, "coverage_", True))
+        self._coverage.register_properties(p.name for p in self._properties)
+        tm = getattr(self._model, "tm", None)
+        if tm is not None and hasattr(tm, "max_actions"):
+            self._coverage.register_actions(
+                tm.format_action(a) for a in range(tm.max_actions)
+            )
+        self._action_label_memo: Dict[Any, str] = {}
         trace_path = getattr(builder, "trace_path_", None)
-        self._trace: Optional[TraceWriter] = (
-            TraceWriter(trace_path, engine=type(self).__name__)
+        self._trace = (
+            make_trace_writer(
+                trace_path,
+                engine=type(self).__name__,
+                format=getattr(builder, "trace_format_", "jsonl"),
+            )
             if trace_path
             else None
         )
@@ -167,9 +186,35 @@ class HostEngineBase(Checker):
     def telemetry(self) -> Dict[str, Any]:
         """The run's metrics-registry snapshot (counters + gauges +
         cumulative phase_ms; names catalogued in obs/metrics.py)."""
+        if self._coverage.enabled:
+            acts = self._coverage.action_counts()
+            self._metrics.set_gauge(
+                "coverage_actions_fired", sum(1 for v in acts.values() if v)
+            )
+            if self._coverage.action_labels is not None:
+                self._metrics.set_gauge(
+                    "coverage_dead_actions", len(self._coverage.dead_actions())
+                )
         snap = self._metrics.snapshot()
         snap["engine"] = type(self).__name__
         return snap
+
+    def coverage(self) -> Dict[str, Any]:
+        """The run's coverage snapshot (obs/coverage.py)."""
+        return self._coverage.snapshot()
+
+    def _action_label(self, action: Any) -> str:
+        """Memoized model.format_action — hot-loop action attribution must
+        not re-format per generated successor. Unhashable actions fall
+        back to formatting each time."""
+        try:
+            label = self._action_label_memo.get(action)
+            if label is None:
+                label = self._model.format_action(action)
+                self._action_label_memo[action] = label
+            return label
+        except TypeError:
+            return self._model.format_action(action)
 
     def _phase_ms_delta(self) -> Dict[str, float]:
         """Per-event phase-timer deltas (ms since the previous trace
@@ -190,6 +235,10 @@ class HostEngineBase(Checker):
         m.set_gauge("frontier_size", int(frontier))
         m.set_gauge("max_depth", int(self._max_depth))
         if self._trace is not None:
+            if self._coverage.enabled and "coverage" not in extra:
+                # Cumulative per-action fire counts ride every progress
+                # event, so a trace alone reconstructs coverage over time.
+                extra["coverage"] = {"actions": self._coverage.action_counts()}
             self._trace.emit(
                 event,
                 states=int(self._state_count),
@@ -218,18 +267,25 @@ class HostEngineBase(Checker):
         property loop at bfs.rs:231-277 / dfs.rs:235-281.
         """
         model = self._model
+        cov = self._coverage if self._coverage.enabled else None
         is_awaiting = False
         for i, prop in enumerate(self._properties):
             if prop.name in discoveries:
                 continue
+            if cov is not None:
+                cov.record_property_eval(prop.name)
             if prop.expectation == Expectation.ALWAYS:
                 if not prop.condition(model, state):
                     discoveries[prop.name] = discovery_value()
+                    if cov is not None:
+                        cov.record_property_hit(prop.name)
                 else:
                     is_awaiting = True
             elif prop.expectation == Expectation.SOMETIMES:
                 if prop.condition(model, state):
                     discoveries[prop.name] = discovery_value()
+                    if cov is not None:
+                        cov.record_property_hit(prop.name)
                 else:
                     is_awaiting = True
             else:  # EVENTUALLY: discoveries only arise at terminal states
@@ -248,6 +304,8 @@ class HostEngineBase(Checker):
         for i, prop in enumerate(self._properties):
             if ebits & (1 << i):
                 discoveries[prop.name] = discovery_value()
+                if self._coverage.enabled:
+                    self._coverage.record_property_hit(prop.name)
 
     def _finish_matched(self, discoveries: Dict[str, Any]) -> bool:
         return self._finish_when.matches(set(discoveries), self._properties)

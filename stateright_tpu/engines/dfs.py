@@ -48,6 +48,7 @@ class DfsChecker(HostEngineBase):
                 self._generated.add(self._fp(symmetry(s)))
             else:
                 self._generated.add(self._fp(s))
+        self._coverage.record_depth(1, len(self._generated))
         # job: (state, fingerprint cons-path, ebits, depth) (dfs.rs:31)
         self._pending = deque(
             (s, _cons(None, self._fp(s)), self._init_ebits, 1) for s in init_states
@@ -104,6 +105,7 @@ class DfsChecker(HostEngineBase):
                 return
 
             # Expand successors (LIFO push for depth-first order).
+            cov = self._coverage if self._coverage.enabled else None
             is_terminal = True
             actions: list = []
             model.actions(state, actions)
@@ -114,6 +116,8 @@ class DfsChecker(HostEngineBase):
                 if not model.within_boundary(next_state):
                     continue
                 self._state_count += 1
+                if cov is not None:
+                    cov.record_action(self._action_label(action))
                 if symmetry is not None:
                     rep_fp = self._fp(symmetry(next_state))
                     if rep_fp in generated:
@@ -129,6 +133,8 @@ class DfsChecker(HostEngineBase):
                         is_terminal = False
                         continue
                     generated.add(next_fp)
+                if cov is not None:
+                    cov.record_depth(depth + 1)
                 is_terminal = False
                 pending.append(
                     (next_state, _cons(fp_node, next_fp), ebits, depth + 1)

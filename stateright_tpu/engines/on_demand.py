@@ -36,6 +36,7 @@ class OnDemandChecker(HostEngineBase):
         self._generated: Dict[int, Optional[int]] = {}
         for s in init_states:
             self._generated.setdefault(self._fp(s), None)
+        self._coverage.record_depth(1, len(self._generated))
         self._pending = deque(
             (s, self._fp(s), self._init_ebits, 1) for s in init_states
         )
@@ -129,6 +130,7 @@ class OnDemandChecker(HostEngineBase):
         if not is_awaiting:
             return
 
+        cov = self._coverage if self._coverage.enabled else None
         is_terminal = True
         actions: List[Any] = []
         model.actions(state, actions)
@@ -139,11 +141,15 @@ class OnDemandChecker(HostEngineBase):
             if not model.within_boundary(next_state):
                 continue
             self._state_count += 1
+            if cov is not None:
+                cov.record_action(self._action_label(action))
             next_fp = self._fp(next_state)
             if next_fp in generated:
                 is_terminal = False
                 continue
             generated[next_fp] = state_fp
+            if cov is not None:
+                cov.record_depth(depth + 1)
             is_terminal = False
             self._pending.appendleft((next_state, next_fp, ebits, depth + 1))
         if is_terminal:

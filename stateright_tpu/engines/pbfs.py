@@ -49,6 +49,7 @@ def _worker(
     n_workers: int,
     model_blob: bytes,
     depth_limit: Optional[int],
+    coverage_enabled: bool,
     in_q,
     out_qs,
     ctl_q,
@@ -68,6 +69,23 @@ def _worker(
     received = 0
     stop = False
     last_report = 0.0
+    # Shard-local coverage tallies (obs/coverage.py); shipped once with the
+    # final report and merged into the coordinator's accumulator.
+    cov_actions: Dict[str, int] = {}
+    cov_depths: Dict[int, int] = {}
+    cov_prop_evals: Dict[str, int] = {}
+    cov_prop_hits: Dict[str, int] = {}
+    label_memo: Dict[Any, str] = {}
+
+    def action_label(action) -> str:
+        try:
+            label = label_memo.get(action)
+            if label is None:
+                label = model.format_action(action)
+                label_memo[action] = label
+            return label
+        except TypeError:
+            return model.format_action(action)
 
     def accept(batch):
         nonlocal received
@@ -76,6 +94,8 @@ def _worker(
             if fp in visited:
                 continue
             visited[fp] = parent_fp
+            if coverage_enabled:
+                cov_depths[depth] = cov_depths.get(depth, 0) + 1
             pending.append((state, fp, ebits, depth))
 
     def flush_out(buckets):
@@ -99,6 +119,16 @@ def _worker(
             if now - last_report < 0.05:
                 return
             last_report = now
+        cov = (
+            {
+                "actions": cov_actions,
+                "depths": cov_depths,
+                "prop_evals": cov_prop_evals,
+                "prop_hits": cov_prop_hits,
+            }
+            if kind == "final" and coverage_enabled
+            else None
+        )
         res_q.put(
             (
                 kind,
@@ -111,6 +141,7 @@ def _worker(
                 received,
                 not pending,
                 dict(discoveries),
+                cov,
             )
         )
 
@@ -169,14 +200,26 @@ def _worker(
             for i, prop in enumerate(properties):
                 if prop.name in discoveries:
                     continue
+                if coverage_enabled:
+                    cov_prop_evals[prop.name] = (
+                        cov_prop_evals.get(prop.name, 0) + 1
+                    )
                 if prop.expectation == Expectation.ALWAYS:
                     if not prop.condition(model, state):
                         discoveries[prop.name] = fp
+                        if coverage_enabled:
+                            cov_prop_hits[prop.name] = (
+                                cov_prop_hits.get(prop.name, 0) + 1
+                            )
                     else:
                         is_awaiting = True
                 elif prop.expectation == Expectation.SOMETIMES:
                     if prop.condition(model, state):
                         discoveries[prop.name] = fp
+                        if coverage_enabled:
+                            cov_prop_hits[prop.name] = (
+                                cov_prop_hits.get(prop.name, 0) + 1
+                            )
                     else:
                         is_awaiting = True
                 else:  # EVENTUALLY
@@ -194,6 +237,9 @@ def _worker(
                 n_children += 1
                 if not model.within_boundary(child):
                     continue
+                if coverage_enabled:
+                    label = action_label(action)
+                    cov_actions[label] = cov_actions.get(label, 0) + 1
                 cfp = model.fingerprint_state(child)
                 buckets[cfp % n_workers].append((child, cfp, fp, ebits, depth + 1))
             if n_children == 0 and ebits:
@@ -201,6 +247,10 @@ def _worker(
                 for i, prop in enumerate(properties):
                     if (ebits >> i) & 1 and prop.name not in discoveries:
                         discoveries[prop.name] = fp
+                        if coverage_enabled:
+                            cov_prop_hits[prop.name] = (
+                                cov_prop_hits.get(prop.name, 0) + 1
+                            )
         flush_out(buckets)
         report("progress")
 
@@ -254,6 +304,7 @@ class ParallelBfsChecker(HostEngineBase):
                     n,
                     model_blob,
                     self._target_max_depth,
+                    self._coverage.enabled,
                     in_qs[w],
                     in_qs,
                     ctl_qs[w],
@@ -284,13 +335,17 @@ class ParallelBfsChecker(HostEngineBase):
         }
 
         def ingest(msg):
-            _, wid, _epoch, sc, uniq, maxd, sent, recv, idle, disc = msg
+            _, wid, _epoch, sc, uniq, maxd, sent, recv, idle, disc, cov = msg
             stats[wid] = dict(
                 sc=sc, uniq=uniq, maxd=maxd, sent=sent, recv=recv,
                 idle=idle, disc=disc,
             )
             for name, fp in disc.items():
                 self._discovery_fps.setdefault(name, fp)
+            if cov:
+                # Workers attach their coverage tallies exactly once, on
+                # the final report; merge is therefore add-exact.
+                self._coverage.merge_counts(**cov)
 
         # Termination: coordinator-driven polling epochs. Each epoch
         # broadcasts a poll; every worker replies with counts sampled at

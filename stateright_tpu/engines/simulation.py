@@ -156,6 +156,11 @@ class SimulationChecker(HostEngineBase):
         discoveries = _TraceDiscoveries(self._discoveries)
         trace_states = 0
         trace_max_depth = 0
+        # Trace-local coverage tallies merge once at walk end — the shared
+        # accumulator's lock must not sit on the per-step path.
+        cov = self._coverage if self._coverage.enabled else None
+        trace_actions: Dict[str, int] = {}
+        trace_depths: Dict[int, int] = {}
 
         chooser_state = chooser.new_state(seed)
         initial_states = model.init_states()
@@ -189,6 +194,9 @@ class SimulationChecker(HostEngineBase):
                 break  # found a loop
             generated.add(key)
             trace_states += 1
+            if cov is not None:
+                d = len(fingerprint_path)
+                trace_depths[d] = trace_depths.get(d, 0) + 1
 
             if self._visitor is not None:
                 self._visitor.visit(
@@ -213,6 +221,9 @@ class SimulationChecker(HostEngineBase):
                 action = actions.pop()
                 next_state = model.next_state(state, action)
                 if next_state is not None:
+                    if cov is not None:
+                        label = self._action_label(action)
+                        trace_actions[label] = trace_actions.get(label, 0) + 1
                     state = next_state
                     advanced = True
                     break
@@ -230,6 +241,8 @@ class SimulationChecker(HostEngineBase):
                 self._max_depth = trace_max_depth
             for name, fp_path in discoveries.local.items():
                 self._discoveries.setdefault(name, fp_path)
+        if cov is not None:
+            cov.merge_counts(actions=trace_actions, depths=trace_depths)
         self._metrics.inc("traces")
         self._metrics.inc("states_generated", trace_states)
 

@@ -88,12 +88,26 @@ P_FIN_ANY = 13  # era exits when (rec & fin_any) != 0
 P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
 P_FIN_ALL_EN = 15
 P_LEN = 16
-# The packed vector is P_LEN + 2*P words long: the tail carries the
-# recorded discovery fingerprint halves (rec_fp1 | rec_fp2), so the era
-# result download returns counters AND discovery fingerprints in ONE
-# round-trip (a separate rec_fp read costs ~100ms on this platform —
-# directly on the time-to-first-counterexample path). The loop reads only
+# The packed vector is P_LEN + 2*P (+ coverage tail) words long: the tail
+# carries the recorded discovery fingerprint halves (rec_fp1 | rec_fp2),
+# so the era result download returns counters AND discovery fingerprints
+# in ONE round-trip (a separate rec_fp read costs ~100ms on this
+# platform — directly on the time-to-first-counterexample path). With
+# coverage enabled (the default) the tail additionally carries this
+# era's on-device coverage histograms (obs/coverage.py) — per-action
+# valid-candidate counts [A], per-property hit counts [P], the consumed
+# row count [1], and the per-depth unique-insert histogram [DEPTH_CAP] —
+# so coverage costs ZERO extra host round-trips. The loop reads only
 # [0:P_LEN] of its input; the tail is write-only output.
+
+
+_COV_W = 16  # relative depth-offset window of the era loop's histogram
+
+
+def _cov_len(A: int, P: int) -> int:
+    from ..obs.coverage import DEPTH_CAP
+
+    return A + P + 1 + DEPTH_CAP
 
 
 def _vcap(A: int, chunk: int) -> int:
@@ -112,7 +126,8 @@ def _vcap(A: int, chunk: int) -> int:
     return min(chunk * A, max(128 * A, (chunk * A) // div))
 
 
-def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False):
+def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False,
+                cov: bool = True):
     """Compile the BFS device "era" loop.
 
     Returns a jitted function
@@ -128,7 +143,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     regardless of depth — the decisive constant on this remote-attached
     platform (see the measured notes below).
     """
-    key = (id(tm), chunk, qcap, len(props), canon)
+    key = (id(tm), chunk, qcap, len(props), canon, cov)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -141,6 +156,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
 
     from ..compat import donate_argnums_safe
     from ..fingerprint import hash_lanes_jnp
+    from ..obs.coverage import DEPTH_CAP
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
     from ..ops.expand import build_expand_lean
@@ -201,7 +217,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         def cond(carry):
             (
                 _table, _queue, _head, count, unique, _gen, steps,
-                err_cnt, _take_cap, rec_acc, _hseen, _f1, _f2, _fd,
+                err_cnt, _take_cap, rec_acc, _hseen, _f1, _f2, _fd, _covc,
             ) = carry
             fin_hit = ((rec_acc & fin_any) != u(0)) | (
                 (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
@@ -231,6 +247,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 facc1,
                 facc2,
                 faccd,
+                covc,
             ) = carry
             take = jnp.minimum(jnp.minimum(count, u(chunk)), take_cap)
             active = jnp.arange(chunk, dtype=jnp.uint32) < take
@@ -312,11 +329,57 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 jnp.minimum(take_cap + u(max(1, chunk // 64)), u(chunk)),
             )
 
+            if cov:
+                # Coverage histograms (obs/coverage.py), all in-carry:
+                # per-action valid counts (the action-major [A*C] validity
+                # mask reshaped and row-summed; gated on ovf exactly like
+                # `gen`, so retried partial steps never double-count),
+                # consumed rows (the per-property evaluation count), and
+                # the per-depth insert histogram (inserts count
+                # unconditionally, matching `unique`). None of these feed
+                # the loop gate.
+                #
+                # The depth histogram deliberately avoids a scatter at the
+                # distinct-candidate width (XLA:CPU scatter-adds cost
+                # ~90ns/slot — 1.1ms/step at rcap width vs 0.13ms for this
+                # form, microbenched) AND the reduction->broadcast
+                # min-select the platform notes
+                # forbid in this carry: ring depth is NON-DECREASING, so
+                # the step's shallowest insert depth is depth[0]+1 — one
+                # lane read. Candidate depths then bucket into _COV_W
+                # relative offsets via plain masked uint32 sums (the
+                # carry-safe reduction pattern, same class as the
+                # discovery-gate sums) and ONE _COV_W-wide scatter lands
+                # them. Offsets past the window clamp into its last
+                # bucket — sum-exact always; a step would have to pop
+                # states spanning >= _COV_W BFS levels at once (>= _COV_W
+                # co-resident singleton levels) to smear a depth, which no
+                # bundled model comes near.
+                act, covp, expanded, dhist = covc
+                pa = ex.valid.reshape(A, chunk).sum(axis=1, dtype=u)
+                act = act + jnp.where(ovf, u(0), pa)
+                expanded = expanded + consumed
+                dmin = depth[0] + u(1)
+                offs = ddepth - dmin
+                cnts = jnp.stack(
+                    [
+                        ((offs == u(w)) & c_new).sum(dtype=u)
+                        for w in range(_COV_W - 1)
+                    ]
+                    + [((offs >= u(_COV_W - 1)) & c_new).sum(dtype=u)]
+                )
+                idx = jnp.minimum(
+                    dmin + jnp.arange(_COV_W, dtype=u), u(DEPTH_CAP - 1)
+                )
+                dhist = dhist.at[idx].add(cnts)
+                covc = (act, covp, expanded, dhist)
+
             if P:
                 hseen_n = []
                 facc1_n = []
                 facc2_n = []
                 faccd_n = []
+                covp_n = []
                 for i in range(P):
                     hits = ex.prop_hits[i]
                     first = hits & ~hseen[i]
@@ -326,13 +389,19 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                     hseen_n.append(hseen[i] | hits)
                     # Scalar discovery bit for the gate: a uint32 sum (NOT
                     # a boolean any()) so the carry stays on the fast path.
-                    rec_acc = rec_acc | (
-                        jnp.minimum(hits.sum(dtype=jnp.uint32), u(1)) << u(i)
-                    )
+                    hs = hits.sum(dtype=jnp.uint32)
+                    rec_acc = rec_acc | (jnp.minimum(hs, u(1)) << u(i))
+                    if cov:
+                        # Per-property hit totals ride the same sums the
+                        # gate already pays for; ovf-gated like `gen` so
+                        # retried rows are not re-counted.
+                        covp_n.append(covc[1][i] + jnp.where(ovf, u(0), hs))
                 hseen = tuple(hseen_n)
                 facc1 = tuple(facc1_n)
                 facc2 = tuple(facc2_n)
                 faccd = tuple(faccd_n)
+                if cov:
+                    covc = (covc[0], tuple(covp_n), covc[2], covc[3])
 
             return (
                 table,
@@ -349,10 +418,21 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 facc1,
                 facc2,
                 faccd,
+                covc,
             )
 
         zero_lane = jnp.zeros(chunk, dtype=jnp.uint32) + (head0 & u(0))
         false_lane = zero_lane != 0
+        covc0 = (
+            (
+                jnp.zeros(A, dtype=jnp.uint32),  # per-action valid counts
+                tuple(u(0) for _ in range(P)),  # per-property hit counts
+                u(0),  # consumed rows (property evaluation count)
+                jnp.zeros(DEPTH_CAP, dtype=jnp.uint32),  # depth histogram
+            )
+            if cov
+            else ()
+        )
         init = (
             table,
             queue,
@@ -369,6 +449,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             tuple(zero_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
+            covc0,
         )
         (
             table,
@@ -385,6 +466,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             facc1,
             facc2,
             faccd,
+            covc_out,
         ) = lax.while_loop(cond, body, init)
 
         # Block-level epilogue (runs ONCE per block, outside the loop, where
@@ -412,32 +494,42 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         maxd = jnp.where(
             steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
-        params_out = jnp.concatenate(
-            [
-                jnp.stack(
-                    [
-                        head,
-                        count,
-                        unique,
-                        rec_bits_out,
-                        depth_limit,
-                        grow_limit,
-                        high_water,
-                        max_steps,
-                        gen,
-                        maxd,
-                        steps,
-                        (err_cnt > 0).astype(u),
-                        take_cap_out,
-                        fin_any,
-                        fin_all,
-                        fin_all_en,
-                    ]
-                ),
-                rec_fp1,
-                rec_fp2,
+        parts = [
+            jnp.stack(
+                [
+                    head,
+                    count,
+                    unique,
+                    rec_bits_out,
+                    depth_limit,
+                    grow_limit,
+                    high_water,
+                    max_steps,
+                    gen,
+                    maxd,
+                    steps,
+                    (err_cnt > 0).astype(u),
+                    take_cap_out,
+                    fin_any,
+                    fin_all,
+                    fin_all_en,
+                ]
+            ),
+            rec_fp1,
+            rec_fp2,
+        ]
+        if cov:
+            # Coverage tail: act[A] | prop_hits[P] | expanded[1] | depth
+            # hist[DEPTH_CAP] — this era's deltas, consumed by the host in
+            # the SAME params download as everything else.
+            act, covp, expanded, dhist = covc_out
+            parts += [
+                act,
+                jnp.stack(list(covp)) if P else jnp.zeros(0, dtype=u),
+                expanded[None],
+                dhist,
             ]
-        )
+        params_out = jnp.concatenate(parts)
         return table, queue, rec_fp1, rec_fp2, params_out
 
     _LOOP_CACHE[key] = (tm, loop)
@@ -449,7 +541,7 @@ _SEED_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
 def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
-                     canon: bool):
+                     canon: bool, cov: bool):
     """Fuse run seeding and the FIRST era into one jitted dispatch.
 
     On this platform every dispatch costs a ~100ms tunnel round-trip, and
@@ -459,7 +551,7 @@ def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
     a run whose discovery fires in era 1 (or that completes outright)
     never pays a second dispatch.
     """
-    key = (id(tm), chunk, qcap, tcap, len(props), canon)
+    key = (id(tm), chunk, qcap, tcap, len(props), canon, cov)
     cached = _SEED_LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -468,7 +560,7 @@ def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
 
     import jax
 
-    loop = _build_loop(tm, props, chunk, qcap, canon)
+    loop = _build_loop(tm, props, chunk, qcap, canon, cov)
     seed = _build_seed(tm.state_width, qcap, tcap)
 
     @jax.jit
@@ -612,8 +704,10 @@ class TpuBfsChecker(HostEngineBase):
         self._ckpt_every = checkpoint_every
         self._resume_from = resume_from
         self._last_ckpt = time.monotonic()
+        self._cov = self._coverage.enabled
         self._loop = _build_loop(
-            self.tm, self._tprops, self._chunk, self._qcap, self._canon
+            self.tm, self._tprops, self._chunk, self._qcap, self._canon,
+            self._cov,
         )
 
         # Host-side bookkeeping.
@@ -651,6 +745,7 @@ class TpuBfsChecker(HostEngineBase):
         C = self._chunk
         P = len(self._tprops)
         W = S + 2  # queue lanes: state | ebits | depth
+        ncov = _cov_len(A, P) if self._cov else 0
 
         depth_limit = (
             self._target_max_depth
@@ -708,6 +803,14 @@ class TpuBfsChecker(HostEngineBase):
             self._state_count = n_init
             if n_init == 0:
                 return
+            if self._cov:
+                # Unique initial states enter the visited set at depth 1
+                # inside the fused seeder, before the loop's histogram
+                # starts counting — record them host-side (distinct rows
+                # == distinct fingerprints short of a hash collision).
+                self._coverage.record_depth(
+                    1, len(np.unique(inits, axis=0))
+                )
             if n_init > self._qcap:
                 raise ValueError("more initial states than queue capacity")
             vcap = _vcap(A, C)
@@ -731,7 +834,7 @@ class TpuBfsChecker(HostEngineBase):
                 max_steps0 = max(
                     1, min(max_steps0, 1 + remaining // max(1, C * A))
                 )
-            template = np.zeros(P_LEN + 2 * P, dtype=np.uint32)
+            template = np.zeros(P_LEN + 2 * P + ncov, dtype=np.uint32)
             template[P_DEPTH_LIMIT] = depth_limit
             template[P_HIGH_WATER] = high_water
             template[P_MAX_STEPS] = max_steps0
@@ -748,7 +851,8 @@ class TpuBfsChecker(HostEngineBase):
             rec_fp2 = jnp.zeros(P, dtype=jnp.uint32)
             _dbg("run: dispatching fused seed+first-era")
             seed_run = _build_seed_loop(
-                tm, self._tprops, C, self._qcap, self._tcap, self._canon
+                tm, self._tprops, C, self._qcap, self._tcap, self._canon,
+                self._cov,
             )
             self._era_t0 = time.monotonic()
             table, queue, rec_fp1, rec_fp2, params_dev = seed_run(
@@ -827,6 +931,20 @@ class TpuBfsChecker(HostEngineBase):
                     if (new_bits >> i) & 1 and p.name not in self._discovery_fps:
                         self._discovery_fps[p.name] = combine64(fp1[i], fp2[i])
                 rec_bits = new_bits
+
+            if self._cov:
+                # The era's coverage deltas ride the same download
+                # (layout: act[A] | prop_hits[P] | expanded | depth hist).
+                base = P_LEN + 2 * P
+                cov_acc = self._coverage
+                cov_acc.record_action_counts(vals[base : base + A])
+                expanded = int(vals[base + A + P])
+                for i, p in enumerate(self._tprops):
+                    cov_acc.record_property_eval(p.name, expanded)
+                    cov_acc.record_property_hit(
+                        p.name, int(vals[base + A + i])
+                    )
+                cov_acc.record_depth_counts(vals[base + A + P + 1 :])
 
             # Spill if the next chunk could overflow the ring. Drain to the
             # MARGIN below the watermark, not just to it: draining only the
@@ -949,7 +1067,7 @@ class TpuBfsChecker(HostEngineBase):
                 host_dirty = True
 
             if host_dirty:
-                arr = np.zeros(P_LEN + 2 * P, dtype=np.uint32)
+                arr = np.zeros(P_LEN + 2 * P + ncov, dtype=np.uint32)
                 arr[:P_LEN] = [
                     head,
                     count,

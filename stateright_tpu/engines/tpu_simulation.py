@@ -74,8 +74,8 @@ P_LEN = 11
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
-def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
-    key = (id(tm), B, L, len(props))
+def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
+    key = (id(tm), B, L, len(props), cov)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]  # (loop, seed_run, n_init)
@@ -87,6 +87,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
     from jax import lax
 
     from ..fingerprint import hash_lanes_jnp
+    from ..obs.coverage import DEPTH_CAP
 
     S = tm.state_width
     A = tm.max_actions
@@ -145,7 +146,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         inits = tuple(jnp.asarray(l) for l in init_lanes_const)
 
         def cond(carry):
-            (_w, _f1, _f2, gen, steps, rec_acc, _h, _pl, maxd) = carry
+            (_w, _f1, _f2, gen, steps, rec_acc, _h, _pl, maxd, _covc) = carry
             fin_hit = ((rec_acc & fin_any) != u(0)) | (
                 (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
             )
@@ -163,6 +164,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
                 hseen,
                 plen,
                 maxd,
+                covc,
             ) = carry
             active = ~frozen
             h1, h2 = hash_lanes_jnp(rows)
@@ -185,6 +187,15 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             counted = active & ~cycle
             ptr = jnp.where(counted, ptr + u(1), ptr)
             gen = gen + counted.sum(dtype=u)
+            if cov:
+                # Depth histogram: each counted state lands at its walk
+                # depth (the just-incremented ptr; clamped into the
+                # DEPTH_CAP overflow bucket). One scatter-add at [B].
+                act, covp, dhist = covc
+                dhist = dhist.at[
+                    jnp.minimum(ptr, u(DEPTH_CAP - 1))
+                ].add(counted.astype(u))
+                covc = (act, covp, dhist)
             # maxd is a PER-WALK lane, reduced once in the epilogue — a
             # scalar max-reduce in the carry knocks the loop off the fast
             # dispatch path on this platform (see engines/tpu_bfs.py).
@@ -237,9 +248,20 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
                 hseen = tuple(
                     (hseen[j] | hits) if j == i else hseen[j] for j in range(P)
                 )
-                rec_acc = rec_acc | (
-                    jnp.minimum(hits.sum(dtype=u), u(1)) << u(i)
-                )
+                hs = hits.sum(dtype=u)
+                rec_acc = rec_acc | (jnp.minimum(hs, u(1)) << u(i))
+                if cov:
+                    # Per-property hit totals ride the sums the discovery
+                    # gate already pays for.
+                    act, covp, dhist = covc
+                    covc = (
+                        act,
+                        tuple(
+                            (covp[j] + hs) if j == i else covp[j]
+                            for j in range(P)
+                        ),
+                        dhist,
+                    )
                 newly_frozen = newly_frozen | first
             frozen = frozen | newly_frozen
 
@@ -249,9 +271,11 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             cum = jnp.zeros(B, dtype=u)
             new_rows = rows
             chosen_any = ne < u(0)  # all-false, varying
+            sels = []
             for a in range(A):
                 sel = valid_a[a] & (cum == pick) & ~chosen_any
                 chosen_any = chosen_any | sel
+                sels.append(sel)
                 new_rows = tuple(
                     jnp.where(sel, succs[a][s], new_rows[s]) for s in range(S)
                 )
@@ -259,6 +283,15 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
 
             advance = counted & ~terminal & ~capped & ~newly_frozen
             restart = active & ~newly_frozen & (cycle | terminal | capped)
+            if cov:
+                # Action coverage: the transition each advancing walk
+                # actually took this step (the simulation twin of the BFS
+                # engines' valid-successor attribution).
+                act, covp, dhist = covc
+                act = act + jnp.stack(
+                    [(sels[a] & advance).sum(dtype=u) for a in range(A)]
+                )
+                covc = (act, covp, dhist)
 
             # Restarts: evolved seed, fresh init state, cleared path row.
             seed2 = prng(seed + u(0x6A09E667))
@@ -289,6 +322,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
                 hseen,
                 plen,
                 maxd,
+                covc,
             )
 
         rows, seed, ptr, ebits = walk[:S], walk[S], walk[S + 1], walk[S + 2]
@@ -307,6 +341,15 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         fp2buf = (fp2buf.reshape(B, L) * keep[:, None]).reshape(-1)
         zero_b = seed & u(0)
         false_b = zero_b != 0
+        covc0 = (
+            (
+                jnp.zeros(A, dtype=u),  # per-action taken counts
+                tuple(zero_b[0] for _ in range(P)),  # per-property hits
+                jnp.zeros(DEPTH_CAP, dtype=u),  # depth histogram
+            )
+            if cov
+            else ()
+        )
         init_carry = (
             (tuple(rows), seed, ptr, ebits, false_b),
             fp1buf,
@@ -317,6 +360,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             tuple(false_b for _ in range(P)),
             tuple(zero_b for _ in range(P)),
             zero_b,
+            covc0,
         )
         (
             (rows, seed, ptr, ebits, frozen),
@@ -328,6 +372,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
             hseen,
             plen,
             maxd,
+            covc_out,
         ) = lax.while_loop(cond, body, init_carry)
 
         # Epilogue: per newly-hit property, report the SHORTEST hit's walk
@@ -346,28 +391,36 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int):
         walk_out = tuple(rows) + (seed, ptr, ebits, frozen.astype(u))
         # Discovery walk indices and path lengths ride the params tail so
         # the era result is ONE download (each separate device read costs
-        # ~100ms here — the simulation TTFC floor).
-        params_out = jnp.concatenate(
-            [
-                jnp.stack(
-                    [
-                        rec_bits_out,
-                        params[P_MAX_STEPS],
-                        params[P_FIN_ANY],
-                        params[P_FIN_ALL],
-                        params[P_FIN_ALL_EN],
-                        params[P_TARGET_GEN],
-                        gen0 + gen,
-                        gen0 + gen,
-                        steps,
-                        maxd.max(),
-                        params[P_SEED],
-                    ]
-                ),
-                disc_walk,
-                disc_plen,
+        # ~100ms here — the simulation TTFC floor). With coverage enabled
+        # the era's histograms (act[A] | prop_hits[P] | depth[DEPTH_CAP])
+        # ride the same download.
+        parts = [
+            jnp.stack(
+                [
+                    rec_bits_out,
+                    params[P_MAX_STEPS],
+                    params[P_FIN_ANY],
+                    params[P_FIN_ALL],
+                    params[P_FIN_ALL_EN],
+                    params[P_TARGET_GEN],
+                    gen0 + gen,
+                    gen0 + gen,
+                    steps,
+                    maxd.max(),
+                    params[P_SEED],
+                ]
+            ),
+            disc_walk,
+            disc_plen,
+        ]
+        if cov:
+            act, covp, dhist = covc_out
+            parts += [
+                act,
+                jnp.stack(list(covp)) if P else jnp.zeros(0, dtype=u),
+                dhist,
             ]
-        )
+        params_out = jnp.concatenate(parts)
         return walk_out, fp1buf, fp2buf, params_out
 
     @jax.jit
@@ -443,8 +496,9 @@ class TpuSimulationChecker(HostEngineBase):
         self._discovery_paths: Dict[str, List[int]] = {}
         self._metrics.set_gauge("walks", self._B)
         self._metrics.set_gauge("walk_cap", self._L)
+        self._cov = self._coverage.enabled
         self._loop, self._seed_run, self._n_init = _build_sim_loop(
-            self.tm, self._tprops, self._B, self._L
+            self.tm, self._tprops, self._B, self._L, self._cov
         )
         self._start()
 
@@ -472,7 +526,11 @@ class TpuSimulationChecker(HostEngineBase):
         )
         target_gen = self._target_state_count or 0
 
-        params = np.zeros(P_LEN + 2 * P, dtype=np.uint32)
+        from ..obs.coverage import DEPTH_CAP
+
+        A = tm.max_actions
+        ncov = (A + P + DEPTH_CAP) if self._cov else 0
+        params = np.zeros(P_LEN + 2 * P + ncov, dtype=np.uint32)
         params[P_MAX_STEPS] = max_sync
         params[P_FIN_ANY] = fin_any
         params[P_FIN_ALL] = fin_all
@@ -505,6 +563,20 @@ class TpuSimulationChecker(HostEngineBase):
             self._metrics.inc("states_generated", gen_total - gen_prev)
             self._state_count = gen_total
             self._max_depth = max(self._max_depth, int(vals[P_MAXD]))
+
+            if self._cov:
+                # Era coverage deltas ride the same params download
+                # (layout: act[A] | prop_hits[P] | depth hist).
+                base = P_LEN + 2 * P
+                cov_acc = self._coverage
+                cov_acc.record_action_counts(vals[base : base + A])
+                for i, p in enumerate(self._tprops):
+                    # Every property is evaluated on every counted state.
+                    cov_acc.record_property_eval(p.name, gen_total - gen_prev)
+                    cov_acc.record_property_hit(
+                        p.name, int(vals[base + A + i])
+                    )
+                cov_acc.record_depth_counts(vals[base + A + P :])
 
             new_bits = int(vals[P_REC])
             if new_bits != rec_bits:

@@ -93,6 +93,7 @@ class VectorizedBfsChecker(HostEngineBase):
         is_new = self._visited.insert_batch(keys, self._nthreads)
         for k in keys[is_new]:
             self._parents[int(k)] = 0
+        self._coverage.record_depth(1, int(is_new.sum()))
         self._metrics.set_gauge("threads", self._nthreads)
         self._blocks = deque()
         if len(inits):
@@ -137,6 +138,8 @@ class VectorizedBfsChecker(HostEngineBase):
             self._max_depth = max(self._max_depth, int(depth.max()))
             live = depth < depth_limit
             lanes = tuple(rows[:, i] for i in range(S))
+            cov = self._coverage if self._coverage.enabled else None
+            act_counts = np.zeros(A, dtype=np.int64) if cov is not None else None
 
             # Property evaluation (ops/expand.py parity).
             ebits = ebits.copy()
@@ -173,6 +176,8 @@ class VectorizedBfsChecker(HostEngineBase):
                 if not v.any():
                     continue
                 idx = np.flatnonzero(v)
+                if act_counts is not None:
+                    act_counts[a] += len(idx)
                 block = np.stack(
                     [np.asarray(succs[a][s])[idx] for s in range(S)], axis=1
                 ).astype(np.uint32)
@@ -189,8 +194,12 @@ class VectorizedBfsChecker(HostEngineBase):
                 bit = np.uint32(1 << self._e_slot[i])
                 prop_hits[i] = live & ~any_valid & ((ebits & bit) != 0)
 
+            n_live = int(live.sum()) if cov is not None else 0
             for i, p in enumerate(self._tprops):
                 hits = prop_hits[i]
+                if cov is not None:
+                    cov.record_property_eval(p.name, n_live)
+                    cov.record_property_hit(p.name, int(hits.sum()))
                 if p.name not in self._discovery_fps and hits.any():
                     # Level order => first block hit is a shallowest hit.
                     self._discovery_fps[p.name] = int(
@@ -216,6 +225,10 @@ class VectorizedBfsChecker(HostEngineBase):
                     self._parents.update(
                         zip(nk.tolist(), np_par.tolist())
                     )
+                    if cov is not None:
+                        cov.record_depth_counts(
+                            np.bincount(cdepth[nidx].astype(np.int64))
+                        )
                     self._blocks.append(
                         (
                             crows[nidx],
@@ -225,6 +238,8 @@ class VectorizedBfsChecker(HostEngineBase):
                         )
                     )
 
+            if cov is not None:
+                cov.record_action_counts(act_counts)
             self._metrics.inc("waves")
             self._obs_event(
                 "wave",

@@ -9,6 +9,17 @@ Reference parity: src/checker/explorer.rs. Routes:
     (counters + the engine's metrics registry, obs/metrics.py) feeding the
     dashboard panel's states/sec sparkline and gauges — beyond the
     reference, which has no runtime observability surface;
+  - ``GET /metrics?format=prometheus`` (alias ``/metrics.prom``) — the
+    same snapshot in the Prometheus text exposition format
+    (``stateright_``-prefixed, text/plain; version=0.0.4), so a scraper
+    can point straight at a running Explorer;
+  - ``GET /coverage`` (alias ``/.coverage``) — the run's coverage
+    snapshot (obs/coverage.py): per-action fire counts, dead actions,
+    depth histogram, per-property eval/hit counts — feeding the
+    dashboard's action bar chart + depth histogram panel;
+  - ``GET /.explain/{fp}/{fp}/...`` — counterexample forensics for the
+    state path named by the fingerprints: per-step action, field-level
+    state diff, and property-predicate flips (`Path.explain_steps`);
   - ``GET /.states/{fp}/{fp}/...`` — walk the state space by fingerprint
     path: returns the successor `StateView`s of the path's final state,
     asking the on-demand checker to expand that frontier node in the
@@ -97,6 +108,51 @@ def _metrics_view(checker: Checker) -> Dict:
         "unique_state_count": checker.unique_state_count(),
         "max_depth": checker.max_depth(),
         "telemetry": checker.telemetry(),
+    }
+
+
+def _metrics_prometheus(checker: Checker) -> str:
+    """GET /metrics?format=prometheus: the same snapshot in Prometheus
+    text exposition format (obs/metrics.py:render_prometheus)."""
+    from ..obs.metrics import render_prometheus
+
+    snap = dict(checker.telemetry())
+    snap.setdefault("state_count", checker.state_count())
+    snap.setdefault("unique_state_count", checker.unique_state_count())
+    snap.setdefault("max_depth", checker.max_depth())
+    snap.setdefault("done", checker.is_done())
+    return render_prometheus(snap)
+
+
+def _coverage_view(checker: Checker) -> Dict:
+    """GET /coverage: the run's coverage snapshot (obs/coverage.py),
+    timestamped like /metrics so the dashboard can poll both."""
+    return {
+        "ts": time.time(),
+        "done": checker.is_done(),
+        "coverage": checker.coverage(),
+    }
+
+
+def explain_view(checker: Checker, fingerprints_path: str) -> Dict:
+    """Handler for GET /.explain/... (testable without a socket):
+    counterexample forensics for the fingerprint path — the per-step
+    records of `Path.explain_steps` plus the rendered narrative."""
+    model = checker.model()
+    cleaned = fingerprints_path.strip("/")
+    if not cleaned:
+        raise KeyError("explain needs a /fp/fp/... fingerprint path")
+    try:
+        fingerprints = [int(part) for part in cleaned.split("/")]
+    except ValueError:
+        raise KeyError(f"Unable to parse fingerprints {cleaned}")
+    try:
+        path = Path.from_fingerprints(model, fingerprints)
+    except Exception as e:
+        raise KeyError(f"Unable to reconstruct path: {e}")
+    return {
+        "steps": path.explain_steps(model),
+        "narrative": path.explain(model),
     }
 
 
@@ -226,13 +282,31 @@ class ExplorerServer:
                 self._send(code, json.dumps(payload).encode(), "application/json")
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/.status":
                     self._send_json(
                         _status_view(explorer.checker, explorer.model, explorer.snapshot)
                     )
-                elif path in ("/metrics", "/.metrics"):
-                    self._send_json(_metrics_view(explorer.checker))
+                elif path in ("/metrics", "/.metrics", "/metrics.prom"):
+                    if path == "/metrics.prom" or "format=prometheus" in query:
+                        self._send(
+                            200,
+                            _metrics_prometheus(explorer.checker).encode(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    else:
+                        self._send_json(_metrics_view(explorer.checker))
+                elif path in ("/coverage", "/.coverage"):
+                    self._send_json(_coverage_view(explorer.checker))
+                elif path.startswith("/.explain"):
+                    try:
+                        self._send_json(
+                            explain_view(
+                                explorer.checker, path[len("/.explain"):]
+                            )
+                        )
+                    except KeyError as e:
+                        self._send(404, str(e).encode(), "text/plain")
                 elif path.startswith("/.states"):
                     try:
                         self._send_json(

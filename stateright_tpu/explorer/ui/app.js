@@ -164,6 +164,124 @@ $("sparkline").addEventListener("mousemove", (ev) => {
 });
 $("sparkline").addEventListener("mouseleave", () => renderSparkline(null));
 
+// ---- coverage panel --------------------------------------------------------
+// Polls /coverage every 2s: per-action fire counts as a horizontal bar
+// chart, the per-depth unique-state histogram as vertical bars, and a
+// dead-action warning list (actions whose guard never fired).
+
+function renderActionBars(actions) {
+  const box = $("action-bars");
+  box.innerHTML = "";
+  const entries = Object.entries(actions);
+  if (!entries.length) return;
+  const max = Math.max(...entries.map(([, v]) => v), 1);
+  for (const [label, count] of entries) {
+    const row = document.createElement("div");
+    row.className = "cov-row" + (count === 0 ? " cov-dead" : "");
+    const name = document.createElement("span");
+    name.className = "cov-label";
+    name.textContent = label;
+    const track = document.createElement("span");
+    track.className = "cov-track";
+    const bar = document.createElement("span");
+    bar.className = "cov-bar";
+    bar.style.width = ((count / max) * 100).toFixed(1) + "%";
+    track.appendChild(bar);
+    const val = document.createElement("span");
+    val.className = "cov-count";
+    val.textContent = count.toLocaleString();
+    row.appendChild(name);
+    row.appendChild(track);
+    row.appendChild(val);
+    box.appendChild(row);
+  }
+}
+
+function renderDepthHist(depths) {
+  const svg = $("depth-hist");
+  const w = svg.clientWidth || 240;
+  const h = svg.clientHeight || 48;
+  svg.innerHTML = "";
+  const entries = Object.entries(depths).map(([d, n]) => [Number(d), n]);
+  if (!entries.length) return;
+  entries.sort((a, b) => a[0] - b[0]);
+  const maxDepth = entries[entries.length - 1][0];
+  const maxN = Math.max(...entries.map(([, n]) => n), 1);
+  const bw = Math.max(1, (w - 2) / (maxDepth + 1) - 1);
+  for (const [d, n] of entries) {
+    const bar = document.createElementNS("http://www.w3.org/2000/svg", "rect");
+    const bh = Math.max(1, (n / maxN) * (h - 2));
+    bar.setAttribute("x", (1 + d * (bw + 1)).toFixed(1));
+    bar.setAttribute("y", (h - 1 - bh).toFixed(1));
+    bar.setAttribute("width", bw.toFixed(1));
+    bar.setAttribute("height", bh.toFixed(1));
+    bar.setAttribute("class", "hist-bar");
+    const tip = document.createElementNS("http://www.w3.org/2000/svg", "title");
+    tip.textContent = `depth ${d}: ${n.toLocaleString()} states`;
+    bar.appendChild(tip);
+    svg.appendChild(bar);
+  }
+  $("depth-readout").textContent = `max depth ${maxDepth}`;
+}
+
+function renderDeadActions(dead) {
+  const box = $("dead-actions");
+  box.innerHTML = "";
+  if (!dead || !dead.length) return;
+  const head = document.createElement("div");
+  head.className = "dead-head";
+  head.textContent =
+    `⚠ ${dead.length} action(s) never fired (dead transition or ` +
+    "mis-modeled guard; speclint STR306):";
+  box.appendChild(head);
+  for (const label of dead) {
+    const row = document.createElement("div");
+    row.className = "dead-row";
+    row.textContent = label;
+    box.appendChild(row);
+  }
+}
+
+async function pollCoverage() {
+  try {
+    const res = await fetch("/coverage");
+    const body = await res.json();
+    const cov = body.coverage || {};
+    if (cov.enabled && Object.keys(cov.actions || {}).length) {
+      $("coverage-panel").hidden = false;
+      renderActionBars(cov.actions);
+      renderDepthHist(cov.depths || {});
+      renderDeadActions(cov.dead_actions);
+    }
+  } catch (e) {
+    /* coverage endpoint unavailable: leave the panel hidden */
+  }
+  setTimeout(pollCoverage, 2000);
+}
+
+// ---- path explain (counterexample forensics) -------------------------------
+
+$("explain-path").addEventListener("click", async () => {
+  const parts = fpPath().split("/").filter(Boolean);
+  const out = $("detail-explain");
+  if (!parts.length) {
+    out.textContent = "navigate into a path first (or open a discovery)";
+    return;
+  }
+  out.textContent = "explaining…";
+  try {
+    const res = await fetch("/.explain/" + parts.join("/"));
+    if (!res.ok) {
+      out.textContent = "error: " + (await res.text());
+      return;
+    }
+    const body = await res.json();
+    out.textContent = body.narrative;
+  } catch (e) {
+    out.textContent = "explain failed: " + e;
+  }
+});
+
 function renderBreadcrumbs() {
   const nav = $("breadcrumbs");
   nav.innerHTML = "";
@@ -261,7 +379,11 @@ $("run-to-completion").addEventListener("click", async () => {
   await fetch("/.runtocompletion", { method: "POST" });
 });
 
-window.addEventListener("hashchange", loadStates);
+window.addEventListener("hashchange", () => {
+  $("detail-explain").textContent = "";
+  loadStates();
+});
 pollStatus();
 pollMetrics();
+pollCoverage();
 loadStates();

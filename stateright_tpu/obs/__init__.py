@@ -1,18 +1,40 @@
-"""Unified engine observability: metrics registry + structured run traces.
+"""Unified engine observability: metrics, coverage, and run traces.
 
-Every engine owns a `MetricsRegistry` (created by `HostEngineBase`) and
-populates it through one common API — counters, gauges, and monotonic phase
-timers — which backs `Checker.telemetry()` uniformly across all nine
-engines. `CheckerBuilder.trace(path)` additionally streams one JSONL event
-per era/wave/round to disk via `TraceWriter`, and
-`CheckerBuilder.profile(dir)` brackets the run with `jax.profiler` traces
-when the profiler is available.
+Every engine owns a `MetricsRegistry` and a `Coverage` accumulator
+(created by `HostEngineBase`) and populates them through one common API —
+counters, gauges, monotonic phase timers, and per-action/per-depth/
+per-property coverage tallies — backing `Checker.telemetry()` and
+`Checker.coverage()` uniformly across all nine engines.
+`CheckerBuilder.trace(path)` additionally streams one JSONL event per
+era/wave/round to disk via `TraceWriter` (`format="chrome"` swaps in the
+Perfetto-loadable `ChromeTraceWriter`), and `CheckerBuilder.profile(dir)`
+brackets the run with `jax.profiler` traces when the profiler is
+available. `render_prometheus` serializes any telemetry snapshot in the
+Prometheus text exposition format (the Explorer serves it at
+``GET /metrics?format=prometheus``).
 
-See `obs/metrics.py` for the metric-name catalog and `obs/trace.py` for the
-trace event schema.
+See `obs/metrics.py` for the metric-name catalog, `obs/coverage.py` for
+coverage-count semantics, and `obs/trace.py` for the trace event schema.
 """
 
-from .metrics import MetricsRegistry
-from .trace import TraceWriter, start_profile, stop_profile
+from .coverage import DEPTH_CAP, Coverage
+from .metrics import MetricsRegistry, render_prometheus
+from .trace import (
+    ChromeTraceWriter,
+    TraceWriter,
+    make_trace_writer,
+    start_profile,
+    stop_profile,
+)
 
-__all__ = ["MetricsRegistry", "TraceWriter", "start_profile", "stop_profile"]
+__all__ = [
+    "DEPTH_CAP",
+    "ChromeTraceWriter",
+    "Coverage",
+    "MetricsRegistry",
+    "TraceWriter",
+    "make_trace_writer",
+    "render_prometheus",
+    "start_profile",
+    "stop_profile",
+]

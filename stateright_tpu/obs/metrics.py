@@ -46,6 +46,13 @@ Gauges (`set_gauge`) — last-observed values:
   ``n_shards`` / ``quota``   mesh engine shard count / exchange quota
   ``lint_errors`` / ``lint_warnings``  speclint finding counts by severity
                            (linted runs only)
+  ``coverage_actions_fired``  distinct actions observed firing so far
+                           (obs/coverage.py; the per-action breakdown is
+                           `Checker.coverage()`, not a metric)
+  ``coverage_dead_actions``  registered actions with a ZERO fire count —
+                           nonzero at run end means dead transitions or
+                           mis-modeled guards (speclint STR306 is the
+                           static twin)
   =======================  ===================================================
 
 Phase timers (`phase(name)` context manager / `add_phase`) — cumulative
@@ -157,3 +164,48 @@ class MetricsRegistry:
                     for k, v in sorted(self._phase_secs.items())
                 }
         return out
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+_PROM_BAD = frozenset(" .-/:")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    safe = "".join("_" if ch in _PROM_BAD else ch for ch in name)
+    return prefix + safe
+
+
+def render_prometheus(snapshot: Dict[str, Any], prefix: str = "stateright_") -> str:
+    """Render a telemetry snapshot (flat counters/gauges + nested
+    ``phase_ms``) in the Prometheus text exposition format (v0.0.4).
+
+    Every numeric metric becomes ``<prefix><name> <value>``; the phase
+    timers flatten to ``<prefix>phase_ms{phase="<name>"}``. Snapshots
+    merge counters and gauges into one namespace, so everything is
+    emitted untyped; non-numeric values (the ``engine`` tag) become
+    labels on an info-style gauge. Serve it from the Explorer via
+    ``GET /metrics?format=prometheus`` (alias ``/metrics.prom``).
+    """
+    lines = []
+    engine = snapshot.get("engine")
+    if engine:
+        name = _prom_name("engine_info", prefix)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f'{name}{{engine="{engine}"}} 1')
+    for key in sorted(snapshot):
+        value = snapshot[key]
+        if key == "phase_ms" and isinstance(value, dict):
+            name = _prom_name("phase_ms", prefix)
+            lines.append(f"# TYPE {name} untyped")
+            for phase in sorted(value):
+                lines.append(f'{name}{{phase="{phase}"}} {value[phase]}')
+            continue
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            continue
+        name = _prom_name(key, prefix)
+        lines.append(f"# TYPE {name} untyped")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

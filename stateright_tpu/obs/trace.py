@@ -7,6 +7,15 @@ coordinator, a *walk* for simulation traces) plus `run_start` / `run_end`
 brackets. Lines are standalone JSON objects, flushed as written, so a
 killed run still leaves a parseable prefix.
 
+`CheckerBuilder.trace(path, format="chrome")` swaps in the
+`ChromeTraceWriter`: the SAME engine-side emit() calls render as Chrome
+trace-event JSON loadable in Perfetto (https://ui.perfetto.dev) or
+`chrome://tracing` — each progress event becomes an instant event on the
+engine's timeline, and its per-event phase-timer deltas become duration
+("X") events stacked on one track per phase, so a run's wall time reads
+as a flame-style lane chart. Records are flushed as written and Perfetto
+tolerates a missing closing bracket, so killed runs stay loadable.
+
 Event schema — every record carries:
 
   ``ts``      wall-clock seconds (time.time())
@@ -77,6 +86,96 @@ class TraceWriter:
         with self._lock:
             if not self._f.closed:
                 self._f.close()
+
+
+class ChromeTraceWriter:
+    """Chrome trace-event JSON writer behind the TraceWriter interface.
+
+    Output is the Trace Event Format's "JSON Array Format": a `[` followed
+    by one event object per line (comma-terminated). Perfetto and
+    chrome://tracing both accept a truncated array, so every record is
+    flushed as written and `close()` merely seals the bracket. Mapping:
+
+      - every emit() becomes an instant event ("ph": "i", global scope)
+        named after the engine event (era/wave/round/walk/run_start/...),
+        carrying the numeric fields as args;
+      - the event's ``phase_ms`` dict (per-event phase-timer DELTAS, see
+        TraceWriter) additionally becomes one duration event ("ph": "X")
+        per phase, ending at the emit timestamp, on a per-phase track
+        (tid = the phase's name) — so phases render as parallel lanes.
+    """
+
+    def __init__(self, path: str, engine: str = ""):
+        self._path = path
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pid = 1
+        self._f = open(path, "w", encoding="utf-8")
+        self._f.write("[\n")
+        self._f.flush()
+
+    def _write(self, record: dict) -> None:
+        self._f.write(json.dumps(record, default=_coerce) + ",\n")
+
+    def emit(self, event: str, **fields: Any) -> None:
+        now_us = time.time() * 1e6
+        phase_ms = fields.pop("phase_ms", None) or {}
+        args = {"engine": self._engine, "seq": 0}
+        for k, v in fields.items():
+            args[k] = v
+        with self._lock:
+            if self._f.closed:
+                return
+            args["seq"] = self._seq
+            self._seq += 1
+            self._write(
+                {
+                    "name": event,
+                    "ph": "i",
+                    "s": "g",
+                    "ts": round(now_us, 1),
+                    "pid": self._pid,
+                    "tid": self._engine or "engine",
+                    "args": args,
+                }
+            )
+            for phase, ms in sorted(phase_ms.items()):
+                dur_us = float(ms) * 1000.0
+                if dur_us <= 0:
+                    continue
+                self._write(
+                    {
+                        "name": phase,
+                        "ph": "X",
+                        "ts": round(now_us - dur_us, 1),
+                        "dur": round(dur_us, 1),
+                        "pid": self._pid,
+                        "tid": phase,
+                        "args": {"engine": self._engine},
+                    }
+                )
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.write("{}]\n")  # sentinel closes the trailing comma
+                self._f.close()
+
+
+TRACE_FORMATS = ("jsonl", "chrome")
+
+
+def make_trace_writer(path: str, engine: str = "", format: str = "jsonl"):
+    """The writer for `CheckerBuilder.trace(path, format=...)`."""
+    if format == "chrome":
+        return ChromeTraceWriter(path, engine=engine)
+    if format == "jsonl":
+        return TraceWriter(path, engine=engine)
+    raise ValueError(
+        f"unknown trace format {format!r}; available: {TRACE_FORMATS}"
+    )
 
 
 # -- jax.profiler bracket (best-effort; no-op off-device) ---------------------

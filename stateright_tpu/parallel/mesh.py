@@ -68,9 +68,9 @@ _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
 def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
-                 quota: int, mesh, axis: str):
+                 quota: int, mesh, axis: str, cov: bool = True):
     key = (
-        id(tm), chunk, qcap, n_shards, quota, len(props),
+        id(tm), chunk, qcap, n_shards, quota, len(props), cov,
         tuple(id(d) for d in mesh.devices.flat),
     )
     cached = _LOOP_CACHE.get(key)
@@ -87,6 +87,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     from ..compat import donate_argnums_safe, get_shard_map
     from ..engines.tpu_bfs import _vcap
     from ..fingerprint import hash_lanes_jnp
+    from ..obs.coverage import DEPTH_CAP
     from ..ops import frontier as fr
     from ..ops import visited_set as vs
     from ..ops.expand import build_expand_lean
@@ -175,6 +176,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 facc1,
                 facc2,
                 faccd,
+                covc,
                 its,
                 _g_cont,
             ) = carry
@@ -289,8 +291,24 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 jnp.minimum(take_cap + u(max(1, chunk // 16)), u(chunk)),
             )
 
+            if cov:
+                # Shard-local coverage (obs/coverage.py): action counts at
+                # the SENDER (where expansion attributes candidates to
+                # their action slot; ovf-gated like `gen`), the consumed
+                # row count, and the depth histogram at the OWNER (where
+                # inserts happen; unconditional like `unique`). Shards
+                # psum these once in the block epilogue.
+                act, covp, expanded, dhist = covc
+                pa = ex.valid.astype(u).reshape(A, chunk).sum(axis=1)
+                act = act + jnp.where(ovf, u(0), pa)
+                expanded = expanded + consumed
+                dhist = dhist.at[
+                    jnp.minimum(recv[S + 3], u(DEPTH_CAP - 1))
+                ].add(is_new.astype(u))
+                covc = (act, covp, expanded, dhist)
+
             if NP_:
-                hseen_n, facc1_n, facc2_n, faccd_n = [], [], [], []
+                hseen_n, facc1_n, facc2_n, faccd_n, covp_n = [], [], [], [], []
                 for pi in range(NP_):
                     hits = ex.prop_hits[pi]
                     first = hits & ~hseen[pi]
@@ -298,16 +316,23 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                     facc2_n.append(jnp.where(first, row_h2, facc2[pi]))
                     faccd_n.append(jnp.where(first, depth, faccd[pi]))
                     hseen_n.append(hseen[pi] | hits)
+                    if cov:
+                        covp_n.append(
+                            covc[1][pi]
+                            + jnp.where(ovf, u(0), hits.sum(dtype=u))
+                        )
                 hseen = tuple(hseen_n)
                 facc1 = tuple(facc1_n)
                 facc2 = tuple(facc2_n)
                 faccd = tuple(faccd_n)
+                if cov:
+                    covc = (covc[0], tuple(covp_n), covc[2], covc[3])
 
             its = its + u(1)
             g_cont = global_gates(count, unique, err_cnt, hseen, rec_bits, its)
             return (
                 table, queue, head, count, unique, gen, steps, err_cnt,
-                take_cap, hseen, facc1, facc2, faccd, its, g_cont,
+                take_cap, hseen, facc1, facc2, faccd, covc, its, g_cont,
             )
 
         zero_lane = jnp.zeros(chunk, dtype=u) + (params[0] & u(0))
@@ -323,6 +348,16 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             rec_bits,
             vzero,
         )
+        covc0 = (
+            (
+                jnp.zeros(A, dtype=u) + vzero,  # per-action valid counts
+                tuple(vzero for _ in range(NP_)),  # per-property hits
+                vzero,  # consumed rows
+                jnp.zeros(DEPTH_CAP, dtype=u) + vzero,  # depth histogram
+            )
+            if cov
+            else ()
+        )
         init = (
             table,
             queue,
@@ -337,12 +372,13 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             tuple(zero_lane for _ in range(NP_)),
             tuple(zero_lane for _ in range(NP_)),
             tuple(zero_lane for _ in range(NP_)),
+            covc0,
             vzero,  # iteration counter (uniform: every shard runs lockstep)
             g0,
         )
         (
             table, queue, head, count, unique, gen, steps, err_cnt,
-            take_cap_out, hseen, facc1, facc2, faccd, _its, _gc,
+            take_cap_out, hseen, facc1, facc2, faccd, covc_out, _its, _gc,
         ) = lax.while_loop(cond, body, init)
 
         # Block epilogue (once per block): BLOCK-LOCAL discovery reports.
@@ -369,14 +405,31 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         maxd = jnp.where(
             steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
-        params_out = jnp.stack(
-            [
-                head, count, unique, rec_bits_out, depth_limit, grow_limit,
-                high_water, max_steps, gen, maxd, steps,
-                (err_cnt > 0).astype(u), take_cap_out,
-                fin_any, fin_all, fin_all_en,
-            ]
-        )
+        parts = [
+            jnp.stack(
+                [
+                    head, count, unique, rec_bits_out, depth_limit,
+                    grow_limit, high_water, max_steps, gen, maxd, steps,
+                    (err_cnt > 0).astype(u), take_cap_out,
+                    fin_any, fin_all, fin_all_en,
+                ]
+            )
+        ]
+        if cov:
+            # Coverage tail, psum'd across the mesh so every shard's row
+            # carries the GLOBAL histograms (the host reads row 0):
+            # act[A] | prop_hits[NP_] | expanded[1] | depth[DEPTH_CAP].
+            act, covp, expanded, dhist = covc_out
+            covp_vec = (
+                jnp.stack(list(covp)) if NP_ else jnp.zeros(0, dtype=u) + vzero
+            )
+            parts.append(
+                lax.psum(
+                    jnp.concatenate([act, covp_vec, expanded[None], dhist]),
+                    axis,
+                )
+            )
+        params_out = jnp.concatenate(parts)
 
         def exp(x):
             return jnp.expand_dims(x, 0)
@@ -522,9 +575,10 @@ class ShardedBfsChecker(HostEngineBase):
                 f"quota (= {4 * self.n_shards * self._quota}); got "
                 f"{self._qcap}. Raise the queue capacity or lower chunk_size."
             )
+        self._cov = self._coverage.enabled
         self._block = _build_block(
             self.tm, self._tprops, self._chunk, self._qcap, self.n_shards,
-            self._quota, self.mesh, "shards",
+            self._quota, self.mesh, "shards", self._cov,
         )
 
         self._unique = 0
@@ -628,6 +682,7 @@ class ShardedBfsChecker(HostEngineBase):
                 seen.add(fp)
                 self._host_insert(table_np[o], int(h1[i]), int(h2[i]))
                 self._unique += 1
+        self._coverage.record_depth(1, len(seen))
 
         table = tuple(jnp.asarray(table_np[:, :, t]) for t in range(4))
         queue = tuple(jnp.asarray(queue_np[:, :, t]) for t in range(W))
@@ -663,11 +718,15 @@ class ShardedBfsChecker(HostEngineBase):
 
         from ..ops import visited_set as vs
 
+        from ..obs.coverage import DEPTH_CAP
+
         tm = self.tm
         S = tm.state_width
         A = tm.max_actions
         C = self._chunk
         N = self.n_shards
+        NP_ = len(self._tprops)
+        ncov = (A + NP_ + 1 + DEPTH_CAP) if self._cov else 0
         max_sync = (
             self._max_sync_steps
             if self._timeout is None and self._ckpt_every is None
@@ -738,9 +797,9 @@ class ShardedBfsChecker(HostEngineBase):
                     1, min(max_steps, 1 + remaining // max(1, N * C * A))
                 )
 
-            params_np = np.zeros((N, P_LEN), dtype=np.uint32)
+            params_np = np.zeros((N, P_LEN + ncov), dtype=np.uint32)
             for s in range(N):
-                params_np[s] = [
+                params_np[s, :P_LEN] = [
                     heads[s], counts[s], per_shard_unique[s], rec_bits,
                     depth_limit, grow_limit, high_water, max_steps,
                     0, 0, 0, 0, take_caps[s],
@@ -770,6 +829,21 @@ class ShardedBfsChecker(HostEngineBase):
             self._metrics.inc("steps", int(vals[:, P_STEPS].sum()))
             self._metrics.inc("states_generated", int(vals[:, P_GEN].sum()))
             self._metrics.set_gauge("take_cap", int(min(take_caps)))
+
+            if self._cov:
+                # The coverage tail is psum'd on device — every shard row
+                # carries the global era deltas; read row 0.
+                base = P_LEN
+                cov_row = vals[0]
+                cov_acc = self._coverage
+                cov_acc.record_action_counts(cov_row[base : base + A])
+                expanded = int(cov_row[base + A + NP_])
+                for pi, p in enumerate(self._tprops):
+                    cov_acc.record_property_eval(p.name, expanded)
+                    cov_acc.record_property_hit(
+                        p.name, int(cov_row[base + A + pi])
+                    )
+                cov_acc.record_depth_counts(cov_row[base + A + NP_ + 1 :])
 
             block_bits = int(np.bitwise_or.reduce(vals[:, P_REC]))
             if block_bits:

@@ -23,6 +23,53 @@ _NONDETERMINISM_HINT = (
 )
 
 
+def _verdict(value) -> str:
+    if value is None:
+        return "?"
+    return "true" if value else "FALSE"
+
+
+def _state_fields(model, state) -> dict:
+    """Named-field view of a state for diffing (values repr'd, so records
+    stay JSON-serializable for the Explorer).
+
+    Tensor-backed states decode through the model's `decode_state` (the
+    human view the Explorer already uses); rich states decompose via
+    dataclass/namedtuple/dict/sequence structure; anything else reports
+    as one opaque field.
+    """
+    import dataclasses
+
+    tm = getattr(model, "tm", None)
+    if tm is not None and hasattr(tm, "decode_state"):
+        try:
+            import numpy as np
+
+            state = tm.decode_state(np.asarray(state, dtype=np.uint32))
+        except Exception:
+            pass  # fall through to the generic decomposition
+    if dataclasses.is_dataclass(state) and not isinstance(state, type):
+        return {k: repr(v) for k, v in vars(state).items()}
+    if hasattr(state, "_asdict"):  # namedtuple
+        return {k: repr(v) for k, v in state._asdict().items()}
+    if isinstance(state, dict):
+        return {str(k): repr(v) for k, v in state.items()}
+    if isinstance(state, (tuple, list)):
+        return {f"[{i}]": repr(v) for i, v in enumerate(state)}
+    return {"state": repr(state)}
+
+
+def _diff_fields(old: dict, new: dict) -> dict:
+    """Field -> [old, new] for every field whose value changed."""
+    out = {}
+    for key in list(old) + [k for k in new if k not in old]:
+        a = old.get(key)
+        b = new.get(key)
+        if a != b:
+            out[key] = [a, b]
+    return out
+
+
 class Path:
     """A list of (state, Optional[action]) pairs; the final pair has action None."""
 
@@ -119,6 +166,97 @@ class Path:
                 return None
             state = nxt
         return state
+
+    # -- forensics -----------------------------------------------------------
+
+    def explain_steps(self, model) -> List[dict]:
+        """Per-step forensic records for this path (the data behind
+        `explain()` and the Explorer's path-detail view).
+
+        Each record describes one transition: the action taken, the
+        FIELD-LEVEL state diff (only what changed), and which property
+        predicates flipped across the step — so an "EVENTUALLY violated,
+        14-step path" reads as a narrative instead of a state dump. The
+        leading record (step 0) is the initial state with every property's
+        starting verdict. Property evaluation is best-effort: a predicate
+        that raises on some state reports as "?" rather than killing the
+        report.
+        """
+        props = list(model.properties())
+        pairs = self._pairs
+
+        def prop_vals(state):
+            vals = {}
+            for p in props:
+                try:
+                    vals[p.name] = bool(p.condition(model, state))
+                except Exception:
+                    vals[p.name] = None
+            return vals
+
+        prev_vals = prop_vals(pairs[0][0])
+        out: List[dict] = [
+            {
+                "step": 0,
+                "action": None,
+                "state": _state_fields(model, pairs[0][0]),
+                "changes": {},
+                "properties": dict(prev_vals),
+                "property_flips": {},
+            }
+        ]
+        for i in range(1, len(pairs)):
+            prev_state, action = pairs[i - 1]
+            state = pairs[i][0]
+            vals = prop_vals(state)
+            flips = {
+                name: [prev_vals[name], vals[name]]
+                for name in vals
+                if vals[name] != prev_vals[name]
+            }
+            out.append(
+                {
+                    "step": i,
+                    "action": model.format_action(action),
+                    "state": _state_fields(model, state),
+                    "changes": _diff_fields(
+                        _state_fields(model, prev_state),
+                        _state_fields(model, state),
+                    ),
+                    "properties": dict(vals),
+                    "property_flips": flips,
+                }
+            )
+            prev_vals = vals
+        return out
+
+    def explain(self, model) -> str:
+        """Human-readable per-step narrative of this path: action taken,
+        field-level state diff, and property-predicate flips. Used by
+        `WriteReporter` when printing discoveries and by the Explorer's
+        path-detail view."""
+        steps = self.explain_steps(model)
+        lines = [f"Path[{len(self)}] explained:"]
+        first = steps[0]
+        init_desc = ", ".join(f"{k}={v}" for k, v in first["state"].items())
+        lines.append(f"  init: {init_desc}")
+        start = ", ".join(
+            f"{name}={_verdict(v)}" for name, v in first["properties"].items()
+        )
+        if start:
+            lines.append(f"  properties: {start}")
+        for rec in steps[1:]:
+            lines.append(f"  {rec['step']}. {rec['action']}")
+            for field, (old, new) in rec["changes"].items():
+                lines.append(f"       {field}: {old} -> {new}")
+            if not rec["changes"]:
+                lines.append("       (no field-level change)")
+            for name, (old, new) in rec["property_flips"].items():
+                lines.append(
+                    f"       ~ property {name!r}: "
+                    f"{_verdict(old)} -> {_verdict(new)}"
+                )
+        return "\n".join(lines) + "\n"
 
     # -- accessors ----------------------------------------------------------
 

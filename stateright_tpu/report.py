@@ -33,6 +33,10 @@ class ReportData:
     telemetry: Dict[str, Any] = None
     # The run's target_state_count, when set — lets reporters compute ETA.
     target_states: Optional[int] = None
+    # Engine coverage snapshot (obs/coverage.py: per-action fire counts,
+    # dead actions, depth histogram, property eval/hit counts). Populated
+    # on the final sample; drives the dead-action warning block.
+    coverage: Dict[str, Any] = None
 
 
 @dataclass
@@ -116,6 +120,7 @@ class WriteReporter(Reporter):
                     f"{k}={v}" for k, v in sorted(data.telemetry.items())
                 )
                 self.writer.write(f"Telemetry. {pairs}\n")
+            self._report_coverage(data.coverage)
         else:
             self.writer.write(
                 f"Checking. states={data.total_states}, "
@@ -123,8 +128,42 @@ class WriteReporter(Reporter):
                 f"{self._rate_suffix(data)}\n"
             )
 
+    def _report_coverage(self, coverage) -> None:
+        """The final coverage summary + dead-action warning block.
+
+        A dead action is a green run's silent lie: the search verified a
+        SMALLER system than the one modeled (a guard is mis-modeled or
+        the transition is genuinely unreachable). TLC prints per-action
+        coverage for exactly this reason; speclint STR306
+        (analysis/README.md) is the pre-flight twin of this check.
+        """
+        if not coverage or not coverage.get("enabled"):
+            return
+        actions = coverage.get("actions") or {}
+        if actions:
+            fired = sum(1 for v in actions.values() if v)
+            self.writer.write(
+                f"Coverage. actions_fired={fired}/{len(actions)}, "
+                f"max_depth={coverage.get('max_depth', 0)}\n"
+            )
+        dead = coverage.get("dead_actions") or []
+        if dead:
+            self.writer.write(
+                f"Warning. {len(dead)} action(s) never fired — dead "
+                "transitions or mis-modeled guards (speclint STR306):\n"
+            )
+            for label in dead:
+                self.writer.write(f"  - {label}\n")
+
     def report_discoveries(self, model, discoveries: Dict[str, ReportDiscovery]) -> None:
         for name in sorted(discoveries):
             d = discoveries[name]
             self.writer.write(f'Discovered "{name}" {d.classification} {d.path}')
             self.writer.write(f"Fingerprint path: {d.path.encode(model)}\n")
+            try:
+                # Counterexample forensics (path.py): per-step action,
+                # field-level diff, and property flips — best-effort, a
+                # model whose re-execution fails still gets the raw path.
+                self.writer.write(d.path.explain(model))
+            except Exception:
+                pass

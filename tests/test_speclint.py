@@ -307,6 +307,7 @@ class DivergentRepTensor(TensorModel):
 
 
 def test_rng_in_actions_flagged():
+    random.seed(0xC0FFEE)  # see test_rng_in_next_state_flagged
     report = analyze(RngActionsModel())
     assert "STR101" in error_codes(report)
 
@@ -317,6 +318,12 @@ def test_mutating_next_state_flagged():
 
 
 def test_rng_in_next_state_flagged():
+    # Pin the GLOBAL random stream the fixture draws from: next_state
+    # replays agree with probability 1/2 per pair, so an arbitrary stream
+    # position (set by whatever tests ran before) can let the
+    # nondeterminism slip through a small sample by sheer luck. Seeding
+    # makes the detection draw deterministic and test-order-independent.
+    random.seed(0xC0FFEE)
     report = analyze(RngNextStateModel())
     assert error_codes(report) & {"STR102", "STR101"}
 
