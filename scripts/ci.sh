@@ -3,11 +3,14 @@
 #
 #   scripts/ci.sh
 #
-# Three stages, fail-fast:
+# Four stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
-#   3. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   3. a stage-profiler smoke: one tiny device-engine run with
+#      `.stage_profile()` must populate the per-stage era breakdown and
+#      reconcile with the era wall time within 10%,
+#   4. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -30,6 +33,27 @@ for model in 2pc:4 2pc-host:3 abd:2 abd-ordered:2 increment:2 \
   echo "-- $model"
   JAX_PLATFORMS=cpu python -m stateright_tpu.analysis "$model"
 done
+
+echo "== stage-profiler smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from stateright_tpu.models import TwoPhaseTensor
+from stateright_tpu.tensor import TensorModelAdapter
+
+c = (
+    TensorModelAdapter(TwoPhaseTensor(3))
+    .checker()
+    .stage_profile(iters=2)
+    .spawn_tpu_bfs(chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 10)
+    .join()
+)
+tel = c.telemetry()
+assert "stage_profile_error" not in tel, tel.get("stage_profile_error")
+stages = {k: v for k, v in tel["phase_ms"].items() if k.startswith("stage_")}
+assert stages, "stage_profile() produced no stage_* phases"
+era = tel["phase_ms"]["device_era"]
+assert era > 0 and abs(sum(stages.values()) - era) <= 0.1 * era, (stages, era)
+print(f"stage smoke OK: {len(stages)} stages attribute {era:.0f} ms of era time")
+PY
 
 echo "== tier-1 tests =="
 set -o pipefail

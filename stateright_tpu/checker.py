@@ -51,6 +51,8 @@ class CheckerBuilder:
         self.trace_format_: str = "jsonl"
         self.profile_dir_: Optional[str] = None
         self.coverage_: bool = True
+        self.stage_profile_: bool = False
+        self.stage_profile_iters_: int = 32
         self.strict_: bool = False
         self.strict_samples_: int = 128
         self.lint_report_: Optional[Any] = None
@@ -134,6 +136,21 @@ class CheckerBuilder:
         """Bracket the run with `jax.profiler` start/stop_trace into
         `log_dir`. A no-op when the profiler is unavailable."""
         self.profile_dir_ = log_dir
+        return self
+
+    def stage_profile(self, enable: bool = True, iters: int = 32) -> "CheckerBuilder":
+        """Attribute the device engines' era wall time across the stages
+        of one BFS/simulation step (expand / hash / probe / claim /
+        compact / ring / canon — obs/stageprof.py). After the run, the
+        engine microbenches each stage in isolation at the run's exact
+        compiled shapes (`iters` repetitions per dispatch) and scales the
+        measured `device_era` time by the resulting shares, surfacing
+        `stage_*` phase timers through `Checker.telemetry()`, the JSONL
+        and Chrome traces, and Prometheus. Costs a few extra dispatches
+        plus one compile per stage at run end; ignored by the host
+        engines (their phases are timed directly)."""
+        self.stage_profile_ = enable
+        self.stage_profile_iters_ = max(1, int(iters))
         return self
 
     # -- static analysis (speclint; stateright_tpu.analysis) -----------------

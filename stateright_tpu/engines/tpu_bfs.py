@@ -323,10 +323,18 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             unique = unique + new_count
             gen = gen + jnp.where(ovf, u(0), ex.generated)
             steps = steps + (~ovf).astype(jnp.uint32)
+            # Regrow at chunk/16 per clean step (was chunk/64): after an
+            # overflow halves the cap, the old creep needed ~64 steps per
+            # doubling to climb back — on 2pc-10 the run spent whole eras
+            # popping quarter-width batches, paying full fixed per-step
+            # cost for a fraction of the throughput (stage-profiled: the
+            # per-step cost is width-insensitive below chunk). /16 restores
+            # full width within ~16 clean steps while still backing off
+            # geometrically under repeated overflow.
             take_cap = jnp.where(
                 ovf,
                 jnp.maximum(take >> u(1), u(1)),
-                jnp.minimum(take_cap + u(max(1, chunk // 64)), u(chunk)),
+                jnp.minimum(take_cap + u(max(1, chunk // 16)), u(chunk)),
             )
 
             if cov:
@@ -624,6 +632,226 @@ def _build_seed(S: int, qcap: int, tcap: int):
     return seed
 
 
+# Stage-profiler kernels: (id(tm), chunk, qcap, P, canon, iters) -> dict of
+# jitted per-stage microbench kernels (obs/stageprof.py). Bounded like the
+# loop caches; keyed without tcap because jit re-specializes per table shape.
+_STAGE_KERNEL_CACHE: Dict[Tuple, Tuple[TensorModel, Dict[str, Any]]] = {}
+
+
+def _build_stage_kernels(tm: TensorModel, props, chunk: int, qcap: int,
+                         canon: bool, iters: int) -> Dict[str, Any]:
+    """Build one jitted microbench kernel per era-loop stage.
+
+    Each kernel has the uniform signature (table, queue, seed) -> uint32
+    scalar and repeats EXACTLY the array program of one stage of one BFS
+    step — at the era loop's compiled widths (chunk / C*A / vcap / rcap /
+    dedup_cap, same derivations as `_build_loop`) — `iters` times inside a
+    `lax.fori_loop`. A data dependence threads every iteration through the
+    carried accumulator (or, for probe/ring, through the table/ring buffers
+    themselves), so XLA can neither elide repetitions nor overlap them;
+    the returned scalar anchors every stage output against dead-code
+    elimination. Synthetic operands come from a lowbias32-style mixer at
+    the right widths; the probe kernel inserts into (a copy-on-write fork
+    of) the run's REAL table so it probes at the run's true load factor —
+    it alternates between two bounded key pools, so the fork's load rises
+    by at most 2*rcap/capacity over the whole measurement.
+    """
+    key = (id(tm), chunk, qcap, len(props), canon, iters)
+    cached = _STAGE_KERNEL_CACHE.get(key)
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+    while len(_STAGE_KERNEL_CACHE) >= 8:
+        _STAGE_KERNEL_CACHE.pop(next(iter(_STAGE_KERNEL_CACHE)))
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..fingerprint import hash_lanes_jnp
+    from ..ops import frontier as fr
+    from ..ops import visited_set as vs
+    from ..ops.expand import build_expand_lean
+
+    S = tm.state_width
+    A = tm.max_actions
+    W = S + 2
+    u = jnp.uint32
+    expand_lean = build_expand_lean(tm, props, chunk)
+    qmask = qcap - 1
+    vcap = _vcap(A, chunk)
+    rcap = max(128 * A, (2 * vcap) // 5)
+    dedup_cap = 1 << max(1, (4 * vcap - 1).bit_length())
+
+    def _mix(x):
+        # lowbias32: cheap elementwise mixer for synthetic lane data.
+        x = x ^ (x >> 16)
+        x = x * u(0x7FEB352D)
+        x = x ^ (x >> 15)
+        x = x * u(0x846CA68B)
+        return x ^ (x >> 16)
+
+    def _lane(n, salt):
+        return _mix(jnp.arange(n, dtype=u) * u(0x9E3779B1) + u(salt))
+
+    @jax.jit
+    def k_expand(table, queue, seed):
+        # Successor generation + property evaluation (expand_lean fuses
+        # them, exactly as the era loop consumes it) over real ring rows.
+        rows0 = tuple(queue[s][:chunk] for s in range(S))
+        ebits0 = queue[S][:chunk]
+        depth0 = queue[S + 1][:chunk]
+        active = jnp.ones(chunk, dtype=bool)
+
+        def body(_i, acc):
+            rows = (rows0[0] ^ (acc & u(1)),) + rows0[1:]
+            ex = expand_lean(rows, ebits0, depth0, active, u(0xFFFFFFFF))
+            return acc + ex.generated
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_hash(table, queue, seed):
+        # Both fingerprint passes of one step: popped rows at [chunk] and
+        # compacted candidates at [vcap].
+        rows0 = tuple(queue[s][:chunk] for s in range(S))
+        cl0 = tuple(_lane(vcap, 11 + s) for s in range(S))
+
+        def body(_i, acc):
+            r = (rows0[0] ^ (acc & u(1)),) + rows0[1:]
+            h1, h2 = hash_lanes_jnp(r)
+            c = (cl0[0] ^ (acc & u(1)),) + cl0[1:]
+            g1, g2 = hash_lanes_jnp(c)
+            return acc + h1[0] + h2[0] + g1[0] + g2[0]
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_probe(table, queue, seed):
+        # Visited-set insert at the distinct-candidate width, against the
+        # run's real table (forked copy-on-write into the loop carry) so
+        # probe chains run at the run's true load factor. Keys alternate
+        # between two fixed pools (flip = acc & 1, data-dependent), so the
+        # fork's load rises by at most 2*rcap over all iterations.
+        pool1 = _lane(rcap, 21)
+        pool2 = _mix(pool1 ^ u(0x6C62272E))
+        ones = jnp.ones(rcap, dtype=bool)
+
+        def body(_i, carry):
+            tbl, acc = carry
+            flip = acc & u(1)
+            dh1 = pool1 ^ flip
+            dh2 = pool2 ^ flip
+            tbl, c_new, _unres, _ovf = vs.insert(tbl, dh1, dh2, dh1, dh2, ones)
+            return tbl, acc + c_new.sum(dtype=u)
+
+        tbl, acc = lax.fori_loop(0, iters, body, (table, seed))
+        return acc + (tbl[0][0] & u(1))
+
+    @jax.jit
+    def k_claim(table, queue, seed):
+        # In-batch dedup (fr.claim_dedup) at the valid-candidate width.
+        p1 = _lane(vcap, 31)
+        p2 = _lane(vcap, 37)
+        valid = jnp.ones(vcap, dtype=bool)
+
+        def body(_i, acc):
+            h1 = p1 ^ (acc & u(1))
+            reps = fr.claim_dedup(h1, p2, valid, dedup_cap)
+            return acc + reps.sum(dtype=u)
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_compact(table, queue, seed):
+        # Both validity compactions of one step — [C*A] -> vcap and
+        # vcap -> rcap — INCLUDING the dependent gathers to the compacted
+        # widths (the S state-lane gathers at vcap, then the S+3
+        # candidate/parent gathers at rcap), which are the stage's real
+        # cost on this platform (~65ns/element dependent-gather latency).
+        flat0 = tuple(_lane(chunk * A, 41 + s) for s in range(S))
+        r1 = _lane(chunk * A, 53)
+        r2 = _lane(vcap, 59)
+        rowl = _lane(chunk, 61)
+
+        def body(_i, acc):
+            m1 = ((r1 ^ acc) & u(3)) == u(0)  # ~25% valid: protocol fanout
+            vids, _vv, n1 = vs._compact_ids(m1, vcap)
+            cl = tuple(flat0[s][vids] for s in range(S))
+            m2 = ((r2 ^ acc) & u(1)) == u(0)  # ~50% distinct post-dedup
+            dids, _dv, n2 = vs._compact_ids(m2, rcap)
+            dl = tuple(cl[s][dids] for s in range(S))
+            src = vids[dids] % u(chunk)
+            acc = acc + n1 + n2 + rowl[src].sum(dtype=u)
+            for lane in dl:
+                acc = acc + lane.sum(dtype=u)
+            return acc
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_ring(table, queue, seed):
+        # One step's ring traffic: pop-gather [chunk] rows, append-scatter
+        # [rcap] rows, threaded through the (forked) ring so iterations
+        # chain. Per-lane sums anchor the full-width gathers and seed the
+        # appended rows (gather -> cand -> scatter -> next gather).
+        base = jnp.arange(rcap, dtype=u)
+
+        def body(_i, carry):
+            q, head, acc = carry
+            popped, _idx = fr.ring_gather(q, head, chunk)
+            cand = tuple(
+                _mix(base * u(2654435761) + popped[w].sum(dtype=u) + u(w * 17))
+                for w in range(W)
+            )
+            valid = jnp.ones(rcap, dtype=bool)
+            q = fr.ring_scatter(q, (head + u(chunk)) & u(qmask), cand, valid)
+            head = (head + u(chunk)) & u(qmask)
+            return q, head, acc + cand[0][0]
+
+        _q, _h, acc = lax.fori_loop(0, iters, body, (queue, seed, seed))
+        return acc
+
+    kernels: Dict[str, Any] = {
+        "expand": k_expand,
+        "hash": k_hash,
+        "probe": k_probe,
+        "claim": k_claim,
+        "compact": k_compact,
+        "ring": k_ring,
+    }
+
+    if canon:
+
+        @jax.jit
+        def k_canon(table, queue, seed):
+            # Symmetry canonicalization at the valid-candidate width.
+            # Lane values are masked into a small domain so the model's
+            # representative program sees plausible field encodings.
+            cl0 = tuple(_lane(vcap, 71 + s) & u(7) for s in range(S))
+
+            def body(_i, acc):
+                cl = (((cl0[0] ^ (acc & u(1))) & u(7)),) + cl0[1:]
+                reps = tm.representative_lanes(jnp, cl)
+                for lane in reps:
+                    acc = acc + lane.sum(dtype=u)
+                return acc
+
+            return lax.fori_loop(0, iters, body, seed)
+
+        kernels["canon"] = k_canon
+
+    _STAGE_KERNEL_CACHE[key] = (tm, kernels)
+    return kernels
+
+
+# Below roughly this many unique states, the host engine's per-state cost
+# beats the device engine's fixed per-dispatch round-trips and compile time
+# (measured: a 2pc-4-sized run reaches only ~32K st/s on device while
+# spawn_bfs clears it host-side before the first era returns — see the
+# README "engine crossover" note).
+SMALL_WORKLOAD_STATES = 10_000
+
+
 class TpuBfsChecker(HostEngineBase):
     """Batched BFS over a TensorModel on the default JAX device."""
 
@@ -661,7 +889,7 @@ class TpuBfsChecker(HostEngineBase):
         # builder asks for symmetry, candidates are canonicalized by the
         # model's batched representative_lanes program before hashing and
         # insertion, so the frontier and visited set live entirely in
-        # representative space (2pc-5: 8,832 -> 665 states).
+        # representative space (2pc-5: 8,832 -> 1,092 states).
         self._canon = builder.symmetry_fn_ is not None
         if self._canon and self.tm.representative_lanes is None:
             raise ValueError(
@@ -721,6 +949,19 @@ class TpuBfsChecker(HostEngineBase):
         # timers (device_era, readback, spill, refill, table_grow).
         self._metrics.set_gauge("take_cap", self._chunk)
         self._era_t0: Optional[float] = None
+        # Per-stage era profiling (CheckerBuilder.stage_profile()): after
+        # the run, microbench each loop stage at the compiled shapes and
+        # attribute the measured device_era time (obs/stageprof.py).
+        self._stage_profile = bool(getattr(builder, "stage_profile_", False))
+        self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
+        # Small-workload guard: with a state-count target under the
+        # crossover, the host engine will beat this one — say so up front
+        # (the run-end check below catches untargeted small runs).
+        if (
+            builder.target_state_count_ is not None
+            and builder.target_state_count_ < SMALL_WORKLOAD_STATES
+        ):
+            self._small_workload_hint(builder.target_state_count_, "targeted")
 
         self._init_ebits_tensor = 0
         e = 0
@@ -1109,9 +1350,70 @@ class TpuBfsChecker(HostEngineBase):
                 table, queue, head, count, rec_bits, rec_fp1, rec_fp2
             )
 
+        if self._unique < SMALL_WORKLOAD_STATES:
+            self._small_workload_hint(self._unique, "explored")
+
+        self._profile_stages(table, queue)
+
         # Retained (on device) for path reconstruction; downloaded lazily.
         self._table_dev = table
         return
+
+    def _small_workload_hint(self, n: int, kind: str) -> None:
+        """One-line telemetry warning: below the crossover the host engine
+        wins (the device engine's fixed dispatch/compile overheads dominate
+        small state spaces — README "engine crossover")."""
+        if getattr(self, "_hinted_small", False):
+            return  # once per run
+        self._hinted_small = True
+        self._metrics.set_gauge("small_workload_hint", n)
+        print(
+            f"[stateright_tpu] small workload ({n} states {kind}, crossover "
+            f"~{SMALL_WORKLOAD_STATES}): spawn_bfs() on the host is "
+            "typically faster than spawn_tpu_bfs() here",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def _profile_stages(self, table, queue) -> None:
+        """Post-run per-stage attribution of the device_era wall time
+        (CheckerBuilder.stage_profile(); obs/stageprof.py). Never fatal:
+        a finished run's results must survive a profiler failure."""
+        if not self._stage_profile:
+            return
+        try:
+            import jax.numpy as jnp
+
+            from ..obs import stageprof
+
+            steps = int(self._metrics.get("steps"))
+            era_secs = self._metrics.phase_ms().get("device_era", 0.0) / 1e3
+            if steps <= 0 or era_secs <= 0.0:
+                return
+            kernels = _build_stage_kernels(
+                self.tm, self._tprops, self._chunk, self._qcap, self._canon,
+                self._stage_iters,
+            )
+            seed = jnp.asarray(1, dtype=jnp.uint32)
+            with self._metrics.phase("profiler_overhead"):
+                timed = stageprof.measure_stage_kernels(
+                    {
+                        name: (fn, (table, queue, seed))
+                        for name, fn in kernels.items()
+                    },
+                    self._stage_iters,
+                )
+            stageprof.attribute_stages(
+                self._metrics, timed, era_secs, steps, self._stage_iters
+            )
+        except Exception as exc:
+            self._metrics.set_gauge("stage_profile_error", repr(exc)[:200])
+            print(
+                f"[stateright_tpu] stage profiling failed (run results "
+                f"unaffected): {exc!r}",
+                file=sys.stderr,
+                flush=True,
+            )
 
     def _grow_table(self, table):
         """Double capacity and rehash on device (no table round-trips)."""

@@ -452,6 +452,179 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
     return loop, seed_run, n_init
 
 
+# Stage-profiler kernels (obs/stageprof.py): one jitted microbench per
+# sim-loop stage, uniform signature (fp1buf, fp2buf, seed) -> uint32.
+_STAGE_KERNEL_CACHE: Dict[Tuple, Tuple[TensorModel, Dict[str, Any]]] = {}
+
+
+def _build_sim_stage_kernels(tm: TensorModel, props, B: int, L: int,
+                             iters: int) -> Dict[str, Any]:
+    """Per-stage microbench kernels for the simulation era loop.
+
+    Stage map (one walk step): `hash` — fingerprint the B current states;
+    `cycle` — the [B, L] own-path membership compare; `record` — the path
+    buffer scatter plus the restart row-clear multiply; `expand` —
+    `tm.step_lanes` + boundary masks + property evaluation (evaluated
+    together in the loop); `choose` — the counter-PRNG pick and the
+    A-round successor select. Same measurement discipline as the BFS
+    engine's `_build_stage_kernels` (engines/tpu_bfs.py): `iters`
+    repetitions per dispatch chained through the carry, outputs anchored
+    into the returned scalar.
+    """
+    key = (id(tm), B, L, len(props), iters)
+    cached = _STAGE_KERNEL_CACHE.get(key)
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+    while len(_STAGE_KERNEL_CACHE) >= 8:
+        _STAGE_KERNEL_CACHE.pop(next(iter(_STAGE_KERNEL_CACHE)))
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..fingerprint import hash_lanes_jnp
+
+    S = tm.state_width
+    A = tm.max_actions
+    u = jnp.uint32
+
+    def _mix(x):
+        x = x ^ (x >> 16)
+        x = x * u(0x7FEB352D)
+        x = x ^ (x >> 15)
+        x = x * u(0x846CA68B)
+        return x ^ (x >> 16)
+
+    def _lane(n, salt):
+        return _mix(jnp.arange(n, dtype=u) * u(0x9E3779B1) + u(salt))
+
+    @jax.jit
+    def k_hash(fp1buf, fp2buf, seed):
+        rows0 = tuple(_lane(B, 3 + s) & u(7) for s in range(S))
+
+        def body(_i, acc):
+            r = ((rows0[0] ^ (acc & u(1))) & u(7),) + rows0[1:]
+            h1, h2 = hash_lanes_jnp(r)
+            return acc + h1[0] + h2[0]
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_cycle(fp1buf, fp2buf, seed):
+        h0 = _lane(B, 13)
+        g0 = _lane(B, 17)
+        ptr = _lane(B, 19) % u(max(1, L))
+        il = jnp.arange(L, dtype=u)
+        f1m = fp1buf.reshape(B, L)
+        f2m = fp2buf.reshape(B, L)
+
+        def body(_i, acc):
+            h1 = h0 ^ (acc & u(1))
+            in_path = (
+                ((f1m == h1[:, None]) & (f2m == g0[:, None])
+                 & (il[None, :] < ptr[:, None])).sum(axis=1, dtype=u)
+                > u(0)
+            )
+            return acc + in_path.sum(dtype=u)
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_record(fp1buf, fp2buf, seed):
+        # Path-buffer scatter of the step's B fingerprints, plus the
+        # restart row-clear multiply — the two [B*L]-touching writes of a
+        # step. Buffers thread through the carry so iterations chain.
+        ib = jnp.arange(B, dtype=u)
+        h0 = _lane(B, 23)
+        restart0 = (_lane(B, 29) & u(15)) == u(0)  # ~6% restarts/step
+
+        def body(i, carry):
+            f1, f2, acc = carry
+            pos = ib * u(L) + ((acc + i.astype(u)) % u(max(1, L)))
+            h1 = h0 ^ (acc & u(1))
+            f1 = f1.at[pos].set(h1, mode="drop", unique_indices=True)
+            f2 = f2.at[pos].set(h1, mode="drop", unique_indices=True)
+            keep = ~restart0
+            f1 = (f1.reshape(B, L) * keep[:, None]).reshape(-1)
+            f2 = (f2.reshape(B, L) * keep[:, None]).reshape(-1)
+            return f1, f2, acc + f1[0]
+
+        _f1, _f2, acc = lax.fori_loop(
+            0, iters, body, (fp1buf, fp2buf, seed)
+        )
+        return acc
+
+    @jax.jit
+    def k_expand(fp1buf, fp2buf, seed):
+        # Successor generation + boundary masks + property evaluation
+        # (the loop evaluates them on the same rows in the same step).
+        rows0 = tuple(_lane(B, 31 + s) & u(7) for s in range(S))
+
+        def body(_i, acc):
+            rows = ((rows0[0] ^ (acc & u(1))) & u(7),) + rows0[1:]
+            succs, amask = tm.step_lanes(jnp, rows)
+            ne = jnp.zeros(B, dtype=u)
+            for a in range(A):
+                v = amask[a] & tm.within_boundary_lanes(jnp, succs[a])
+                ne = ne + v.astype(u)
+            for p in props:
+                ne = ne + p.check(jnp, rows).sum(dtype=u)
+            return acc + ne[0] + ne.sum(dtype=u)
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    @jax.jit
+    def k_choose(fp1buf, fp2buf, seed):
+        # Counter-PRNG pick + the A-round uniform successor select.
+        rows0 = tuple(_lane(B, 47 + s) for s in range(S))
+        succs0 = tuple(
+            tuple(_lane(B, 101 + a * S + s) for s in range(S))
+            for a in range(A)
+        )
+        valid0 = tuple((_lane(B, 211 + a) & u(1)) == u(0) for a in range(A))
+        ptr = _lane(B, 223) % u(max(1, L))
+
+        def prng(x):
+            x = (x ^ (x >> u(16))) * u(0x7FEB352D)
+            x = (x ^ (x >> u(15))) * u(0x846CA68B)
+            return x ^ (x >> u(16))
+
+        def body(_i, acc):
+            sd = _lane(B, 227) ^ acc
+            ne = jnp.zeros(B, dtype=u)
+            for a in range(A):
+                ne = ne + valid0[a].astype(u)
+            r = prng(sd ^ (ptr * u(0x9E3779B9)))
+            pick = jnp.where(ne > u(0), r % jnp.maximum(ne, u(1)), u(0))
+            cum = jnp.zeros(B, dtype=u)
+            new_rows = rows0
+            chosen_any = ne < u(0)
+            for a in range(A):
+                sel = valid0[a] & (cum == pick) & ~chosen_any
+                chosen_any = chosen_any | sel
+                new_rows = tuple(
+                    jnp.where(sel, succs0[a][s], new_rows[s])
+                    for s in range(S)
+                )
+                cum = cum + valid0[a].astype(u)
+            out = acc
+            for lane in new_rows:
+                out = out + lane[0]
+            return out
+
+        return lax.fori_loop(0, iters, body, seed)
+
+    kernels = {
+        "hash": k_hash,
+        "cycle": k_cycle,
+        "record": k_record,
+        "expand": k_expand,
+        "choose": k_choose,
+    }
+    _STAGE_KERNEL_CACHE[key] = (tm, kernels)
+    return kernels
+
+
 class TpuSimulationChecker(HostEngineBase):
     """B batched seeded random walks on the default JAX device."""
 
@@ -497,6 +670,8 @@ class TpuSimulationChecker(HostEngineBase):
         self._metrics.set_gauge("walks", self._B)
         self._metrics.set_gauge("walk_cap", self._L)
         self._cov = self._coverage.enabled
+        self._stage_profile = bool(getattr(builder, "stage_profile_", False))
+        self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
         self._loop, self._seed_run, self._n_init = _build_sim_loop(
             self.tm, self._tprops, self._B, self._L, self._cov
         )
@@ -607,11 +782,53 @@ class TpuSimulationChecker(HostEngineBase):
                 generated=gen_total - gen_prev,
             )
             if self._finish_matched(self._discovery_paths):
-                return
+                break
             if target_gen and gen_total >= target_gen:
-                return
+                break
             if self._timed_out():
+                break
+
+        self._profile_stages(fp1buf, fp2buf)
+
+    def _profile_stages(self, fp1buf, fp2buf) -> None:
+        """Post-run per-stage attribution of device_era wall time
+        (CheckerBuilder.stage_profile(); obs/stageprof.py). Never fatal."""
+        if not self._stage_profile:
+            return
+        try:
+            import jax.numpy as jnp
+
+            from ..obs import stageprof
+
+            steps = int(self._metrics.get("steps"))
+            era_secs = self._metrics.phase_ms().get("device_era", 0.0) / 1e3
+            if steps <= 0 or era_secs <= 0.0:
                 return
+            kernels = _build_sim_stage_kernels(
+                self.tm, self._tprops, self._B, self._L, self._stage_iters
+            )
+            seed = jnp.asarray(1, dtype=jnp.uint32)
+            with self._metrics.phase("profiler_overhead"):
+                timed = stageprof.measure_stage_kernels(
+                    {
+                        name: (fn, (fp1buf, fp2buf, seed))
+                        for name, fn in kernels.items()
+                    },
+                    self._stage_iters,
+                )
+            stageprof.attribute_stages(
+                self._metrics, timed, era_secs, steps, self._stage_iters
+            )
+        except Exception as exc:
+            import sys
+
+            self._metrics.set_gauge("stage_profile_error", repr(exc)[:200])
+            print(
+                f"[stateright_tpu] stage profiling failed (run results "
+                f"unaffected): {exc!r}",
+                file=sys.stderr,
+                flush=True,
+            )
 
     # -- accessors ----------------------------------------------------------
 

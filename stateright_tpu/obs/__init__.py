@@ -19,6 +19,7 @@ coverage-count semantics, and `obs/trace.py` for the trace event schema.
 
 from .coverage import DEPTH_CAP, Coverage
 from .metrics import MetricsRegistry, render_prometheus
+from .stageprof import STAGE_ORDER, stage_rows
 from .trace import (
     ChromeTraceWriter,
     TraceWriter,
@@ -32,9 +33,11 @@ __all__ = [
     "ChromeTraceWriter",
     "Coverage",
     "MetricsRegistry",
+    "STAGE_ORDER",
     "TraceWriter",
     "make_trace_writer",
     "render_prometheus",
+    "stage_rows",
     "start_profile",
     "stop_profile",
 ]

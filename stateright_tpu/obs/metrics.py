@@ -53,6 +53,24 @@ Gauges (`set_gauge`) — last-observed values:
                            nonzero at run end means dead transitions or
                            mis-modeled guards (speclint STR306 is the
                            static twin)
+  ``small_workload_hint``  set (to the state count seen) when a device-engine
+                           run targets/explores fewer states than the
+                           host-vs-device crossover (~10k): the host engine
+                           would likely have been faster (one stderr line
+                           accompanies it)
+  ``stage_profile_iters``  per-stage loop repetitions used by the era stage
+                           profiler (`CheckerBuilder.stage_profile(iters=)`)
+  ``stage_us_per_step``    dict gauge: RAW isolated per-step cost of each era
+                           stage in microseconds, before proportional
+                           attribution (non-numeric; skipped by the
+                           Prometheus exposition)
+  ``stage_profile_model_pct``  how much of the measured era wall time the
+                           isolated-stage cost model accounts for (100 =
+                           stages explain the loop; low = fixed per-step
+                           overhead dominates; high = fusion beats the
+                           isolated kernels)
+  ``stage_profile_error``  repr of the exception if stage profiling failed
+                           (profiling is best-effort and never fails a run)
   =======================  ===================================================
 
 Phase timers (`phase(name)` context manager / `add_phase`) — cumulative
@@ -75,6 +93,19 @@ dict in `snapshot()`:
   ``visited_insert``     visited-set probe + insert (vbfs native set)
   ``walk``               one host simulation trace end-to-end
   ``poll``               one pbfs coordinator polling epoch
+  ``stage_<name>``       the device engines' era wall time attributed to one
+                         pipeline stage (``stage_expand`` / ``stage_hash`` /
+                         ``stage_probe`` / ``stage_claim`` / ``stage_compact``
+                         / ``stage_ring``; plus ``stage_canon`` under
+                         symmetry, ``stage_exchange`` on the sharded mesh,
+                         and ``stage_cycle`` / ``stage_choose`` /
+                         ``stage_record`` on the simulation engine). Present
+                         only when the run used
+                         `CheckerBuilder.stage_profile()`; the stage shares
+                         sum to ``device_era`` by construction
+                         (obs/stageprof.py documents the attribution)
+  ``profiler_overhead``  wall time the stage profiler itself spent measuring
+                         (outside ``device_era``; the timed run is clean)
   =====================  =====================================================
 
 Engines only populate the rows that exist on their architecture; absent

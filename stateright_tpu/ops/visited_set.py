@@ -46,17 +46,20 @@ this module does. The insert's cost is dependent-gather latency
 could only beat with scatter/gather DMA primitives TPU Pallas does not
 expose for this access pattern.
 
-The probe loops are COUNTED fori loops in two phases: a short full-width
+The probe loops are COUNTED fori loops in phases: a short full-width
 phase resolves the overwhelming majority, then the rare stragglers are
-cumsum-compacted into a narrow tail batch that probes further. Two
-constraints force this shape on the target platform: (a) a top-level
-`lax.while_loop` with a data-dependent predicate costs a host round-trip
-per iteration on remote-attached devices, and (b) compiled programs whose
-probe loop exceeds ~10 rounds fall off the runtime's fast dispatch path
-entirely (measured: 8 rounds = 10us/step, 12 rounds = 270ms/step). The
-candidates that neither phase resolves are reported `unresolved`; callers
-must grow the table and keep load <= MAX_LOAD so that outcome stays
-(measurably) one-in-millions — and fail loudly if it happens.
+cumsum-compacted into a cascade of count-gated tail stages at narrowing
+widths that probe further. Two constraints force this shape on the target
+platform: (a) a top-level `lax.while_loop` with a data-dependent
+predicate costs a host round-trip per iteration on remote-attached
+devices, and (b) compiled programs whose probe loop exceeds ~10 rounds
+fall off the runtime's fast dispatch path entirely (measured: 8 rounds =
+10us/step, 12 rounds = 270ms/step) — so the stages that WOULD push past
+that budget must stay behind count gates that keep them out of the
+common-case step. The candidates that no phase resolves are reported
+`unresolved`; callers must grow the table and keep load <= MAX_LOAD so
+that outcome stays (measurably) one-in-millions — and fail loudly if it
+happens.
 """
 
 from __future__ import annotations
@@ -65,26 +68,36 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-PRIMARY_ROUNDS = 2  # primary probe rounds (platform fast-path limit ~10/loop)
-# At MAX_LOAD=0.25, P(probe chain > 2) ~ 6%; the narrow tail absorbs those.
+PRIMARY_ROUNDS = 3  # primary probe rounds (platform fast-path limit ~10/loop)
+# At MAX_LOAD=0.25, P(probe chain > 3) ~ 1.6%: three full-width rounds keep
+# the straggler population under the first tail stage's cap even for the
+# largest bench batches (2pc-10: rcap ~ 85K distinct candidates at load
+# 0.23 leaves ~1K stragglers; TWO rounds left ~4.5K — overflowing the
+# 4096-wide tail and force-engaging every later stage on every step, the
+# stage-profiled cause of the 2pc-10 per-step cliff).
 REHASH_ROUNDS = 8  # deeper primary phase for whole-table rehashes
-# Tail stages run at the narrow TAIL_CAP width and are GATED on their
-# straggler count (lax.cond): a stage with nothing to do costs one scalar
-# reduction instead of its probe rounds. Total probe budget per insert is
-# PRIMARY (or REHASH) + sum(TAIL_STAGE_ROUNDS); stages engage
+# Tail stages: (rounds, width) pairs at GEOMETRICALLY NARROWING widths.
+# Each stage re-compacts the candidates still unresolved at that point
+# into its own [width] batch, so late stages probe at the width of the
+# straggler population they actually face (tens of candidates) instead of
+# the first stage's worst-case cap. The whole stage — compaction, probe
+# rounds, and fold-back — is GATED on its live straggler count
+# (lax.cond): a stage with nothing to do costs one scalar reduction
+# instead of a cumsum + gathers + probe rounds. Total probe budget per
+# insert is PRIMARY (or REHASH) + sum of stage rounds; stages engage
 # automatically as the load factor pushes chains longer.
-TAIL_STAGE_ROUNDS = (4, 12)
+TAIL_STAGES = ((4, 4096), (4, 1024), (8, 256))
 # Lookups must probe at least as deep as the deepest possible placement:
 # a rehash insert can place a key up to REHASH_ROUNDS + sum(tail) probes
-# along its sequence.
-MAX_PROBES = REHASH_ROUNDS + sum(TAIL_STAGE_ROUNDS)
-# Tail width: stragglers after the primary phase scale with the batch
-# (expected ~ n * load^PRIMARY_ROUNDS, approaching n/16 near MAX_LOAD), so
-# giant batches at high load CAN overflow this — overflow surfaces as
+# along its sequence. (Keep this >= the budget of every table written by
+# older builds: checkpointed tables are probed with TODAY'S constant.)
+MAX_PROBES = REHASH_ROUNDS + sum(r for r, _ in TAIL_STAGES)
+# First-stage width: stragglers after the primary phase scale with the
+# batch (expected ~ n * load^PRIMARY_ROUNDS near MAX_LOAD), so giant
+# batches at high load CAN overflow it — overflow surfaces as
 # `unresolved` candidates, which engine callers must treat as RETRYABLE
 # (shrink the batch via the partial-commit take_cap protocol and redo;
 # inserts are idempotent), not as instant failure.
-TAIL_CAP = 4096
 # Probe chains stay within these budgets when the load factor stays under
 # MAX_LOAD (double hashing => geometric chains: P(len>3) ~ MAX_LOAD^3 per
 # candidate, and the tail phase absorbs the stragglers).
@@ -191,8 +204,8 @@ def _compact_ids(mask, cap: int):
 
 
 def _probe_all(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
-    """Primary probe rounds, then straggler compaction into a narrow tail
-    that probes further. Returns (table, claim, done, is_new)."""
+    """Primary probe rounds, then a cascade of gated straggler stages at
+    narrowing widths. Returns (table, claim, done, is_new)."""
     u = jnp.uint32
     n = h1.shape[0]
 
@@ -200,47 +213,53 @@ def _probe_all(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
         table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds
     )
 
-    # Compact the rare stragglers into a narrow tail batch and probe on.
-    tail_ids, t_valid, _n_un = _compact_ids(~done, TAIL_CAP)
-    th1 = h1[tail_ids]
-    th2 = h2[tail_ids]
-    tp1 = p1[tail_ids]
-    tp2 = p2[tail_ids]
-    t_stride = stride[tail_ids]
-    t_idx = jnp.where(t_valid, idx[tail_ids], u(0))
-    t_done = ~t_valid
-    # All-false but derived from varying data so the loop carry type stays
-    # consistent under shard_map (constant zeros would be unvarying).
-    t_new = t_valid & ~t_valid
-    for stage_rounds in TAIL_STAGE_ROUNDS:
-        # Gate each stage on its live straggler count: in the common case
-        # (low load) later stages have nothing to do and reduce to one
-        # scalar sum + a branch instead of stage_rounds probe rounds.
-        pending = (~t_done).sum(dtype=u)
+    for stage_rounds, stage_cap in TAIL_STAGES:
+        # Gate each stage — INCLUDING its compaction — on the live
+        # straggler count: with nothing left the stage reduces to one
+        # scalar sum + a branch instead of a full-width cumsum, gathers,
+        # and stage_rounds probe rounds. Candidates that overflow a
+        # stage's width stay un-done and fall through to the next stage
+        # (or out, reported unresolved by the caller).
+        pending = (~done).sum(dtype=u)
 
-        def run_stage(op, stage_rounds=stage_rounds):
-            table, claim, t_idx, t_done, t_new = op
+        def run_stage(op, stage_rounds=stage_rounds, stage_cap=stage_cap):
+            table, claim, idx, done, is_new = op
+            tail_ids, t_valid, _n_un = _compact_ids(~done, stage_cap)
+            th1 = h1[tail_ids]
+            th2 = h2[tail_ids]
+            tp1 = p1[tail_ids]
+            tp2 = p2[tail_ids]
+            t_stride = stride[tail_ids]
+            t_idx = jnp.where(t_valid, idx[tail_ids], u(0))
+            t_done = ~t_valid
+            # All-false but derived from varying data so the loop carry
+            # type stays consistent under shard_map (constant zeros would
+            # be unvarying).
+            t_new = t_valid & ~t_valid
             table, claim, t_idx, t_done, t_new = _probe_rounds(
                 table, claim, th1, th2, tp1, tp2, t_stride, t_idx, t_done,
                 t_new, stage_rounds,
             )
-            return table, claim, t_idx, t_done, t_new
+            # Fold the stage's results back into the full-width masks; the
+            # probe POSITION folds back too, so the next stage's batch
+            # resumes each survivor's chain where this one left it.
+            t_my = jnp.arange(stage_cap, dtype=u)
+            upd = jnp.where(t_valid, tail_ids, u(n) + t_my)
+            is_new = is_new.at[upd].max(
+                t_new, mode="drop", unique_indices=True
+            )
+            done = done.at[upd].max(t_done, mode="drop", unique_indices=True)
+            idx = idx.at[upd].set(t_idx, mode="drop", unique_indices=True)
+            return table, claim, idx, done, is_new
 
         def skip_stage(op):
             return op
 
-        table, claim, t_idx, t_done, t_new = lax.cond(
+        table, claim, idx, done, is_new = lax.cond(
             pending > u(0), run_stage, skip_stage,
-            (table, claim, t_idx, t_done, t_new),
+            (table, claim, idx, done, is_new),
         )
 
-    # Fold tail results back into the full-width masks. Candidates that
-    # overflowed the tail simply stay un-done (reported unresolved by the
-    # caller).
-    t_my = jnp.arange(TAIL_CAP, dtype=u)
-    upd = jnp.where(t_valid, tail_ids, u(n) + t_my)
-    is_new = is_new.at[upd].max(t_new, mode="drop", unique_indices=True)
-    done = done.at[upd].max(t_done, mode="drop", unique_indices=True)
     return table, claim, done, is_new
 
 

@@ -457,6 +457,239 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     return block
 
 
+# Stage-profiler kernels (obs/stageprof.py): one shard_map'd jitted
+# microbench per era-loop stage, signature (table, queue, seed[N]) ->
+# psummed uint32 per shard. All shards run each stage in lockstep (the
+# final psum couples them), so the dispatch wall time measured by the host
+# IS the global per-stage time — the mesh twin of the single-device
+# engine's `_build_stage_kernels` (engines/tpu_bfs.py), plus `exchange`
+# for the owner-bucketing + all_to_all hop this engine alone has.
+_STAGE_KERNEL_CACHE: Dict[Tuple, Tuple[TensorModel, Dict[str, Any]]] = {}
+
+
+def _build_mesh_stage_kernels(tm: TensorModel, props, chunk: int, qcap: int,
+                              n_shards: int, quota: int, mesh, axis: str,
+                              iters: int) -> Dict[str, Any]:
+    key = (
+        id(tm), chunk, qcap, n_shards, quota, len(props), iters,
+        tuple(id(d) for d in mesh.devices.flat),
+    )
+    cached = _STAGE_KERNEL_CACHE.get(key)
+    if cached is not None and cached[0] is tm:
+        return cached[1]
+    while len(_STAGE_KERNEL_CACHE) >= 8:
+        _STAGE_KERNEL_CACHE.pop(next(iter(_STAGE_KERNEL_CACHE)))
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec
+
+    from ..compat import get_shard_map
+    from ..engines.tpu_bfs import _vcap
+    from ..fingerprint import hash_lanes_jnp
+    from ..ops import frontier as fr
+    from ..ops import visited_set as vs
+    from ..ops.expand import build_expand_lean
+
+    S = tm.state_width
+    A = tm.max_actions
+    W = S + 2
+    X = S + 4
+    u = jnp.uint32
+    expand_lean = build_expand_lean(tm, props, chunk)
+    qmask = qcap - 1
+    vcap = _vcap(A, chunk)
+    dedup_cap = 1 << max(1, (2 * vcap - 1).bit_length())
+    rwidth = n_shards * quota  # exchange receive / insert / append width
+
+    def _mix(x):
+        x = x ^ (x >> 16)
+        x = x * u(0x7FEB352D)
+        x = x ^ (x >> 15)
+        x = x * u(0x846CA68B)
+        return x ^ (x >> 16)
+
+    def _lane(n, salt):
+        return _mix(jnp.arange(n, dtype=u) * u(0x9E3779B1) + u(salt))
+
+    def _wrap(stage_body):
+        def per_device(table, queue, seed):
+            table = tuple(t[0] for t in table)
+            queue = tuple(q[0] for q in queue)
+            acc = stage_body(table, queue, seed[0])
+            # One final psum couples the shards, so the host-observed
+            # dispatch time is gated by the slowest shard (lockstep, like
+            # the real era loop's per-step global gates).
+            return jnp.expand_dims(lax.psum(acc, axis), 0)
+
+        spec = PartitionSpec(axis)
+        return jax.jit(
+            get_shard_map()(
+                per_device, mesh=mesh, in_specs=(spec,) * 3,
+                out_specs=spec,
+            )
+        )
+
+    def b_expand(table, queue, s0):
+        rows0 = tuple(queue[s][:chunk] for s in range(S))
+        ebits0 = queue[S][:chunk]
+        depth0 = queue[S + 1][:chunk]
+        active = jnp.ones(chunk, dtype=bool)
+
+        def body(_i, acc):
+            rows = (rows0[0] ^ (acc & u(1)),) + rows0[1:]
+            ex = expand_lean(rows, ebits0, depth0, active, u(0xFFFFFFFF))
+            return acc + ex.generated
+
+        return lax.fori_loop(0, iters, body, s0)
+
+    def b_hash(table, queue, s0):
+        rows0 = tuple(queue[s][:chunk] for s in range(S))
+        cl0 = tuple(_lane(vcap, 11 + s) for s in range(S))
+
+        def body(_i, acc):
+            r = (rows0[0] ^ (acc & u(1)),) + rows0[1:]
+            h1, h2 = hash_lanes_jnp(r)
+            c = (cl0[0] ^ (acc & u(1)),) + cl0[1:]
+            g1, g2 = hash_lanes_jnp(c)
+            return acc + h1[0] + h2[0] + g1[0] + g2[0]
+
+        return lax.fori_loop(0, iters, body, s0)
+
+    def b_compact(table, queue, s0):
+        # The single validity compaction [C*A] -> vcap plus the dependent
+        # gathers to the compacted width (state lanes from the padded
+        # batch, parent/ebits/depth lanes from the popped rows).
+        flat0 = tuple(_lane(chunk * A, 41 + s) for s in range(S))
+        r1 = _lane(chunk * A, 53)
+        rowls = tuple(queue[t][:chunk] for t in range(min(4, W)))
+
+        def body(_i, acc):
+            m1 = ((r1 ^ acc) & u(3)) == u(0)
+            vids, _vv, n1 = vs._compact_ids(m1, vcap)
+            src = vids % u(chunk)
+            acc = acc + n1
+            for lane in flat0:
+                acc = acc + lane[vids].sum(dtype=u)
+            for lane in rowls:
+                acc = acc + lane[src].sum(dtype=u)
+            return acc
+
+        return lax.fori_loop(0, iters, body, s0)
+
+    def b_claim(table, queue, s0):
+        p1 = _lane(vcap, 31)
+        p2 = _lane(vcap, 37)
+        valid = jnp.ones(vcap, dtype=bool)
+
+        def body(_i, acc):
+            h1 = p1 ^ (acc & u(1))
+            reps = fr.claim_dedup(h1, p2, valid, dedup_cap)
+            return acc + reps.sum(dtype=u)
+
+        return lax.fori_loop(0, iters, body, s0)
+
+    def b_exchange(table, queue, s0):
+        # Owner bucketing (the [vcap, N] one-hot cumsum rank), the send
+        # scatters, and the all_to_all ICI hop for all X lanes.
+        ch0 = _lane(vcap, 61)
+        iota_v = jnp.arange(vcap, dtype=u)
+        lanes0 = tuple(_lane(vcap, 67 + x) for x in range(X))
+
+        def body(_i, acc):
+            ch1 = ch0 ^ (acc & u(1))
+            reps = ((ch1 >> u(4)) & u(3)) != u(3)  # ~75% survive dedup
+            owner = ch1 % u(n_shards)
+            onehot = (
+                owner[:, None] == jnp.arange(n_shards, dtype=u)[None, :]
+            ) & reps[:, None]
+            csum = jnp.cumsum(onehot.astype(u), axis=0)
+            rank = (csum * onehot.astype(u)).sum(axis=1) - u(1)
+            dest = jnp.where(
+                reps & (rank < u(quota)),
+                owner * u(quota) + rank,
+                u(rwidth) + iota_v,
+            )
+            send = [
+                jnp.zeros(rwidth, dtype=u)
+                .at[dest]
+                .set(c ^ acc, mode="drop", unique_indices=True)
+                for c in lanes0
+            ]
+            recv = [
+                lax.all_to_all(
+                    x, axis, split_axis=0, concat_axis=0, tiled=True
+                )
+                for x in send
+            ]
+            for rl in recv:
+                acc = acc + rl.sum(dtype=u)
+            return acc
+
+        return lax.fori_loop(0, iters, body, s0)
+
+    def b_probe(table, queue, s0):
+        # Owner-side insert at the receive width, against the run's real
+        # table shard (copy-on-write fork in the carry; two alternating
+        # key pools bound the fork's extra load at 2*rwidth keys).
+        me = lax.axis_index(axis).astype(u)
+        pool1 = _mix(
+            jnp.arange(rwidth, dtype=u) * u(0x9E3779B1)
+            + me * u(0x85EBCA77) + u(21)
+        )
+        pool2 = _mix(pool1 ^ u(0x6C62272E))
+        ones = jnp.ones(rwidth, dtype=bool)
+
+        def body(_i, carry):
+            tbl, acc = carry
+            flip = acc & u(1)
+            dh1 = pool1 ^ flip
+            dh2 = pool2 ^ flip
+            tbl, c_new, _un, _ov = vs.insert(tbl, dh1, dh2, dh1, dh2, ones)
+            return tbl, acc + c_new.sum(dtype=u)
+
+        tbl, acc = lax.fori_loop(0, iters, body, (table, s0))
+        return acc + (tbl[0][0] & u(1))
+
+    def b_ring(table, queue, s0):
+        base = jnp.arange(rwidth, dtype=u)
+
+        def body(_i, carry):
+            q, head, acc = carry
+            popped, _idx = fr.ring_gather(q, head, chunk)
+            cand = tuple(
+                _mix(
+                    base * u(2654435761)
+                    + popped[w].sum(dtype=u) + u(w * 17)
+                )
+                for w in range(W)
+            )
+            valid = jnp.ones(rwidth, dtype=bool)
+            q = fr.ring_scatter(
+                q, (head + u(chunk)) & u(qmask), cand, valid
+            )
+            return q, (head + u(chunk)) & u(qmask), acc + cand[0][0]
+
+        _q, _h, acc = lax.fori_loop(0, iters, body, (queue, s0, s0))
+        return acc
+
+    kernels = {
+        name: _wrap(body_fn)
+        for name, body_fn in (
+            ("expand", b_expand),
+            ("hash", b_hash),
+            ("compact", b_compact),
+            ("claim", b_claim),
+            ("exchange", b_exchange),
+            ("probe", b_probe),
+            ("ring", b_ring),
+        )
+    }
+    _STAGE_KERNEL_CACHE[key] = (tm, kernels)
+    return kernels
+
+
 _GROW_CACHE: Dict[Tuple, Any] = {}
 
 
@@ -576,6 +809,8 @@ class ShardedBfsChecker(HostEngineBase):
                 f"{self._qcap}. Raise the queue capacity or lower chunk_size."
             )
         self._cov = self._coverage.enabled
+        self._stage_profile = bool(getattr(builder, "stage_profile_", False))
+        self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
         self._block = _build_block(
             self.tm, self._tprops, self._chunk, self._qcap, self.n_shards,
             self._quota, self.mesh, "shards", self._cov,
@@ -926,8 +1161,54 @@ class ShardedBfsChecker(HostEngineBase):
                 table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
                 take_caps, disc_depth_best, per_shard_unique,
             )
+        self._profile_stages(table, queue)
         self._table_dev = table
         return
+
+    def _profile_stages(self, table, queue) -> None:
+        """Post-run per-stage attribution of device_era wall time across
+        the mesh (CheckerBuilder.stage_profile(); obs/stageprof.py). The
+        kernels run every shard in lockstep, so the attributed `stage_*`
+        phases are GLOBAL times; `steps` is normalized to lockstep era
+        iterations (total steps / n_shards). Never fatal."""
+        if not self._stage_profile:
+            return
+        try:
+            import jax.numpy as jnp
+
+            from ..obs import stageprof
+
+            steps = int(self._metrics.get("steps")) // max(1, self.n_shards)
+            era_secs = self._metrics.phase_ms().get("device_era", 0.0) / 1e3
+            if steps <= 0 or era_secs <= 0.0:
+                return
+            kernels = _build_mesh_stage_kernels(
+                self.tm, self._tprops, self._chunk, self._qcap,
+                self.n_shards, self._quota, self.mesh, "shards",
+                self._stage_iters,
+            )
+            seeds = jnp.arange(1, self.n_shards + 1, dtype=jnp.uint32)
+            with self._metrics.phase("profiler_overhead"):
+                timed = stageprof.measure_stage_kernels(
+                    {
+                        name: (fn, (table, queue, seeds))
+                        for name, fn in kernels.items()
+                    },
+                    self._stage_iters,
+                )
+            stageprof.attribute_stages(
+                self._metrics, timed, era_secs, steps, self._stage_iters
+            )
+        except Exception as exc:
+            import sys
+
+            self._metrics.set_gauge("stage_profile_error", repr(exc)[:200])
+            print(
+                f"[stateright_tpu] stage profiling failed (run results "
+                f"unaffected): {exc!r}",
+                file=sys.stderr,
+                flush=True,
+            )
 
     # -- checkpoint/resume --------------------------------------------------
 

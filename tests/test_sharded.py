@@ -57,6 +57,28 @@ def test_two_shards_also_exact(devices):
     assert sb.unique_state_count == 288
 
 
+def test_paxos2_sharded_golden(devices):
+    # Register family on the mesh: the paxos twin's 16,668-state space
+    # (examples/paxos.rs:327) must survive fingerprint-ownership sharding
+    # and the all_to_all exchange exactly — same golden as the host
+    # oracle and the single-device engine.
+    from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+    sb = ShardedBfs(PaxosTensorExhaustive(2), devices, chunk_size=256).run()
+    assert sb.unique_state_count == 16_668
+
+
+def test_abd2_sharded_golden(devices):
+    # linearizable-register check 2 (ABD, unordered) on the mesh: 544
+    # states (linearizable-register.rs:287), linearizability holds — no
+    # counterexample may appear from cross-shard routing.
+    from stateright_tpu.models.abd import AbdTensor
+
+    sb = ShardedBfs(AbdTensor(2), devices, chunk_size=128).run()
+    assert sb.unique_state_count == 544
+    assert "linearizable" not in sb.discovery_fps
+
+
 def test_checker_api_and_cross_shard_paths(devices):
     # The full Checker interface: spawn via the builder, reconstruct a
     # discovery Path across shard tables, and replay it through the model.

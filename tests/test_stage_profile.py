@@ -1,0 +1,134 @@
+"""Stage-attributed era profiling (obs/stageprof.py + the engines'
+`_build_stage_kernels`).
+
+`CheckerBuilder.stage_profile()` decomposes each device engine's opaque
+era wall time across its pipeline stages by microbenching every stage in
+isolation at the era's exact shapes, then attributing the measured
+`device_era` phase proportionally. The contract under test:
+
+  - `stage_<name>` phase timers appear in `Checker.telemetry()` and sum
+    to the `device_era` phase within 10% (by construction — proportional
+    attribution; the raw isolated costs stay in `stage_us_per_step`);
+  - each engine reports its own architecture's stage set;
+  - profiling never changes verdicts or counts;
+  - the small-workload guard (a hint, not a profiler feature, but wired
+    through the same telemetry) fires one gauge + one stderr line.
+"""
+
+import pytest
+
+from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
+from stateright_tpu.obs import STAGE_ORDER, stage_rows
+from stateright_tpu.tensor import TensorModelAdapter
+
+
+def _stage_phases(telemetry):
+    phase_ms = telemetry.get("phase_ms", {})
+    return {k: v for k, v in phase_ms.items() if k.startswith("stage_")}
+
+
+def test_tpu_bfs_stage_breakdown_reconciles():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .stage_profile(iters=4)
+        .spawn_tpu_bfs(chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 10)
+        .join()
+    )
+    assert c.unique_state_count() == 288  # profiling must not perturb counts
+    tel = c.telemetry()
+    assert "stage_profile_error" not in tel, tel.get("stage_profile_error")
+    stages = _stage_phases(tel)
+    # The single-device BFS pipeline: every stage materializes.
+    for name in ("expand", "hash", "probe", "claim", "compact", "ring"):
+        assert f"stage_{name}" in stages, (name, sorted(stages))
+    era = tel["phase_ms"]["device_era"]
+    total = sum(stages.values())
+    assert era > 0
+    assert abs(total - era) <= 0.1 * era, (total, era)
+    # Raw isolated measurements ride alongside the attribution.
+    assert set(tel["stage_us_per_step"]) == {
+        k[len("stage_"):] for k in stages
+    }
+    assert tel["stage_profile_iters"] == 4
+    assert tel["stage_profile_model_pct"] > 0
+    # stage_rows orders for display without dropping anything.
+    rows = stage_rows(tel["phase_ms"])
+    assert [n for n, _ in rows if n in STAGE_ORDER] == [n for n, _ in rows]
+    assert len(rows) == len(stages)
+
+
+def test_stage_profile_off_by_default():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .spawn_tpu_bfs(chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 10)
+        .join()
+    )
+    assert not _stage_phases(c.telemetry())
+
+
+def test_tpu_simulation_stage_breakdown():
+    c = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .stage_profile(iters=4)
+        .target_state_count(2000)
+        .spawn_tpu_simulation(7, walks=64, walk_cap=16)
+        .join()
+    )
+    tel = c.telemetry()
+    assert "stage_profile_error" not in tel, tel.get("stage_profile_error")
+    stages = _stage_phases(tel)
+    # The simulation engine's walk pipeline, not the BFS one.
+    for name in ("hash", "cycle", "record", "expand", "choose"):
+        assert f"stage_{name}" in stages, (name, sorted(stages))
+    era = tel["phase_ms"]["device_era"]
+    total = sum(stages.values())
+    assert era > 0 and abs(total - era) <= 0.1 * era, (total, era)
+
+
+def test_sharded_stage_breakdown_includes_exchange():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .stage_profile(iters=2)
+        .spawn_sharded_bfs(
+            devices=jax.devices()[:8],
+            chunk_size=64,
+            queue_capacity_per_shard=1 << 11,
+            table_capacity_per_shard=1 << 10,
+        )
+        .join()
+    )
+    assert c.unique_state_count() == 288
+    tel = c.telemetry()
+    assert "stage_profile_error" not in tel, tel.get("stage_profile_error")
+    stages = _stage_phases(tel)
+    # The mesh adds the owner-routed all_to_all exchange stage.
+    for name in ("expand", "hash", "probe", "exchange", "ring"):
+        assert f"stage_{name}" in stages, (name, sorted(stages))
+    era = tel["phase_ms"]["device_era"]
+    total = sum(stages.values())
+    assert era > 0 and abs(total - era) <= 0.1 * era, (total, era)
+
+
+def test_small_workload_hint_fires(capsys):
+    # 2pc-3 explores 288 states, far below the ~10k crossover where the
+    # device engine's dispatch overhead stops paying for itself.
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .spawn_tpu_bfs(chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 10)
+        .join()
+    )
+    assert c.telemetry().get("small_workload_hint") == 288
+    err = capsys.readouterr().err
+    assert "spawn_bfs() on the host" in err
+    # One line only, even though both the spawn-time and run-end checks see
+    # a small number.
+    assert err.count("small workload") == 1
