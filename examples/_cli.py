@@ -11,11 +11,37 @@ nonzero when error-severity diagnostics are found.
 
 from __future__ import annotations
 
+import inspect
 import sys
 from typing import Callable, Optional
 
 from stateright_tpu import WriteReporter
 from stateright_tpu.actor import Network
+
+
+def _supported_kwargs(fn: Callable, kwargs: dict) -> dict:
+    """Filter kwargs down to those `fn` accepts (older spawn_info hooks
+    take no arguments; newer ones take record/faults/duration/engine)."""
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return {}
+    if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()):
+        return kwargs
+    return {k: v for k, v in kwargs.items() if k in params}
+
+
+def _pop_flag(rest: list, flag: str) -> Optional[str]:
+    """Remove `--flag VALUE` from an argv slice, returning VALUE (or None)."""
+    if flag not in rest:
+        return None
+    i = rest.index(flag)
+    if i + 1 >= len(rest):
+        print(f"{flag} requires a value")
+        raise SystemExit(1)
+    value = rest[i + 1]
+    del rest[i : i + 2]
+    return value
 
 
 def print_coverage(checker) -> None:
@@ -39,6 +65,7 @@ def example_main(
     default_client_count: int = 2,
     default_network: str = "unordered_nonduplicating",
     spawn_info: Optional[Callable] = None,
+    conform_info: Optional[Callable] = None,
 ):
     argv = list(sys.argv[1:] if argv is None else argv)
     subcommand = argv[0] if argv else "check"
@@ -71,6 +98,7 @@ def example_main(
         if not report.ok:
             raise SystemExit(1)
     elif subcommand == "explore":
+        trace = _pop_flag(rest, "--trace")
         client_count = int(arg(0, default_client_count))
         address = arg(1, "localhost:3000")
         network = Network.from_name(arg(2, default_network))
@@ -78,16 +106,51 @@ def example_main(
             f"Exploring state space for {name} with {client_count} clients on {address}."
         )
         build_model(client_count, network).checker().serve(
-            address
+            address, trace=trace
         )
     elif subcommand == "spawn":
         if spawn_info is None:
             print(f"{name} does not support the spawn subcommand.")
             raise SystemExit(1)
-        spawn_info()
+        kwargs = {
+            "record": _pop_flag(rest, "--record"),
+            "faults": _pop_flag(rest, "--faults"),
+            "duration": _pop_flag(rest, "--duration"),
+            "engine": _pop_flag(rest, "--engine"),
+        }
+        kwargs = {k: v for k, v in kwargs.items() if v is not None}
+        if "duration" in kwargs:
+            kwargs["duration"] = float(kwargs["duration"])
+        supported = _supported_kwargs(spawn_info, kwargs)
+        dropped = sorted(set(kwargs) - set(supported))
+        if dropped:
+            print(f"{name} spawn ignores flags: {', '.join('--' + f for f in dropped)}")
+        spawn_info(**supported)
+    elif subcommand == "conform":
+        if conform_info is None:
+            print(f"{name} does not support the conform subcommand.")
+            raise SystemExit(1)
+        if not rest:
+            print(f"Usage: {sys.argv[0]} conform TRACE [CLIENT_COUNT]")
+            raise SystemExit(1)
+        trace_path = rest[0]
+        # None -> the example infers the topology from the trace's roster.
+        client_count = int(rest[1]) if len(rest) > 1 else None
+        report, tester = conform_info(trace_path, client_count)
+        print(report.format(), end="")
+        if tester is not None:
+            serialized = tester.serialized_history()
+            if serialized is None:
+                print(f"history: NOT serializable ({len(tester)} ops)")
+            else:
+                print(f"history: serializable ({len(tester)} ops)")
+                for op, ret in serialized:
+                    print(f"  {op!r} -> {ret!r}")
+        if not report.ok:
+            raise SystemExit(1)
     else:
         print(
             f"Usage: {sys.argv[0]} "
-            "[check|check-dfs|check-simulation|lint|explore|spawn]"
+            "[check|check-dfs|check-simulation|lint|explore|spawn|conform]"
         )
         raise SystemExit(1)

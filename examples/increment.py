@@ -1,8 +1,16 @@
-"""Unsynchronized-counter CLI (the lost-update race demo).
+"""Unsynchronized-counter CLI (the lost-update race demo) — plus its
+message-passing twin, a replicated counter actor system that runs for
+real over UDP with trace recording and conformance checking.
 
 Reference: examples/increment.rs. The checker surfaces the race as a "fin"
 always-property counterexample; `check-sym` demonstrates symmetry reduction
 (13 → 8 unique states at 2 threads).
+
+The actor section (CounterActor/BumpClient) is the conformance smoke
+system (scripts/ci.sh): clients bump a session-caching idempotent counter
+server with per-client request ids and a retry timer, so the system stays
+correct — and its recorded traces stay model-explainable — under injected
+drop/duplicate/delay faults.
 
 Usage::
 
@@ -10,23 +18,294 @@ Usage::
     python examples/increment.py check-sym [THREAD_COUNT]
     python examples/increment.py check-tpu [THREAD_COUNT]
     python examples/increment.py lint [THREAD_COUNT]
+    python examples/increment.py spawn-record [TRACE] [SECONDS] [SEED]
+    python examples/increment.py conform TRACE [CLIENT_COUNT]
 """
 
 from __future__ import annotations
 
 import sys
+import time
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from stateright_tpu import WriteReporter
+from stateright_tpu import Expectation, WriteReporter
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
 from stateright_tpu.models import Increment, IncrementTensor
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.spec import SequentialSpec
+
+
+# ---------------------------------------------------------------------------
+# The replicated-counter actor system (the conformance demo).
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Bump:
+    """Client -> server: increment, tagged with the client's request id."""
+
+    request_id: int
+
+
+@dataclass(frozen=True)
+class BumpOk:
+    """Server -> client: the counter value this bump produced."""
+
+    request_id: int
+    value: int
+
+
+@dataclass(frozen=True)
+class CounterState:
+    value: int
+    # (client id, last request id, value replied) per client, sorted by
+    # client — the session cache that makes duplicate Bumps idempotent.
+    sessions: Tuple[Tuple[int, int, int], ...]
+
+
+@dataclass(frozen=True)
+class BumpClientState:
+    awaiting: Optional[int]
+    done: int
+
+
+class CounterActor(Actor):
+    """A single counter server. Duplicated/retransmitted Bumps re-reply the
+    cached BumpOk instead of double-counting (exactly-once effect over an
+    at-least-once network)."""
+
+    def name(self) -> str:
+        return "Counter"
+
+    def on_start(self, id: Id, out: Out) -> CounterState:
+        return CounterState(value=0, sessions=())
+
+    def on_msg(self, id: Id, state: CounterState, src: Id, msg: Any, out: Out):
+        if not isinstance(msg, Bump):
+            return None
+        client = int(src)
+        cached = {c: (rid, value) for c, rid, value in state.sessions}
+        if client in cached:
+            rid, value = cached[client]
+            if msg.request_id == rid:
+                out.send(src, BumpOk(rid, value))  # duplicate: re-reply
+                return None
+            if msg.request_id < rid:
+                return None  # stale retransmit: drop
+        new_value = state.value + 1
+        cached[client] = (msg.request_id, new_value)
+        out.send(src, BumpOk(msg.request_id, new_value))
+        return CounterState(
+            value=new_value,
+            sessions=tuple(sorted((c, r, v) for c, (r, v) in cached.items())),
+        )
+
+
+class BumpClient(Actor):
+    """Bumps the counter forever: request ids 1, 2, 3, ... with a retry
+    timer re-sending the in-flight Bump (at-least-once delivery)."""
+
+    RETRY = "retry"
+
+    def __init__(self, server_id, retry_range: Optional[Tuple[float, float]] = None):
+        from stateright_tpu.actor import model_timeout
+
+        self.server_id = Id(server_id)
+        self.retry_range = retry_range if retry_range is not None else model_timeout()
+
+    def name(self) -> str:
+        return "BumpClient"
+
+    def on_start(self, id: Id, out: Out) -> BumpClientState:
+        out.set_timer(self.RETRY, self.retry_range)
+        out.send(self.server_id, Bump(1))
+        return BumpClientState(awaiting=1, done=0)
+
+    def on_msg(self, id: Id, state: BumpClientState, src: Id, msg: Any, out: Out):
+        if (
+            isinstance(msg, BumpOk)
+            and state.awaiting is not None
+            and msg.request_id == state.awaiting
+        ):
+            nxt = state.awaiting + 1
+            out.send(self.server_id, Bump(nxt))
+            return BumpClientState(awaiting=nxt, done=state.done + 1)
+        return None  # stale/duplicate BumpOk
+
+    def on_timeout(self, id: Id, state: BumpClientState, timer: Any, out: Out):
+        out.set_timer(self.RETRY, self.retry_range)
+        if state.awaiting is not None:
+            out.send(self.server_id, Bump(state.awaiting))
+        return None
+
+
+# -- sequential spec + model -------------------------------------------------
+
+@dataclass(frozen=True)
+class Inc:
+    pass
+
+
+@dataclass(frozen=True)
+class IncOk:
+    value: int
+
+
+class CounterSpec(SequentialSpec):
+    """Sequential counter: each Inc returns the post-increment value."""
+
+    def __init__(self, value: int = 0):
+        self.value = value
+
+    def copy(self) -> "CounterSpec":
+        return CounterSpec(self.value)
+
+    def invoke(self, op):
+        assert isinstance(op, Inc), op
+        self.value += 1
+        return IncOk(self.value)
+
+    def __eq__(self, other):
+        return isinstance(other, CounterSpec) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("CounterSpec", self.value))
+
+    def __repr__(self):
+        return f"CounterSpec({self.value})"
+
+
+def counter_model(client_count: int, network: Optional[Network] = None) -> ActorModel:
+    """Actor 0 is the counter server; actors 1..client_count its clients."""
+    if network is None:
+        network = Network.new_unordered_duplicating()
+
+    def consistent(model, state) -> bool:
+        server = state.actor_states[0]
+        # Each client's request ids are 1..rid, each bumping once: the
+        # counter must equal the sum of the per-session high-water marks.
+        return server.value == sum(rid for _c, rid, _v in server.sessions)
+
+    return (
+        ActorModel(cfg=client_count)
+        .actor(CounterActor())
+        .add_actors(BumpClient(Id(0)) for _ in range(client_count))
+        .with_init_network(network)
+        .with_within_boundary(
+            lambda cfg, state: all(
+                s.done <= 2
+                for s in state.actor_states
+                if isinstance(s, BumpClientState)
+            )
+        )
+        .property(Expectation.ALWAYS, "counter consistent", consistent)
+        .property(
+            Expectation.SOMETIMES,
+            "op completed",
+            lambda model, state: any(
+                s.done >= 1
+                for s in state.actor_states
+                if isinstance(s, BumpClientState)
+            ),
+        )
+    )
+
+
+# -- record -> conform demo path ---------------------------------------------
+
+def counter_history(events, tester=None) -> LinearizabilityTester:
+    """Extract the clients' Inc operations from a recorded trace."""
+    from stateright_tpu.conformance import extract_history
+
+    if tester is None:
+        tester = LinearizabilityTester(CounterSpec(0))
+
+    def invoke_of(actor, msg):
+        if isinstance(msg, list) and len(msg) == 2 and msg[0] == "Bump":
+            return (msg[1], Inc())
+        return None
+
+    def return_of(actor, msg):
+        if isinstance(msg, list) and len(msg) == 3 and msg[0] == "BumpOk":
+            return (msg[1], IncOk(msg[2]))
+        return None
+
+    return extract_history(events, tester, invoke_of, return_of)
+
+
+def record_counter_demo(
+    path: str,
+    duration: float = 1.0,
+    client_count: int = 2,
+    seed: Optional[int] = None,
+    engine: str = "auto",
+    base_port: int = 46000,
+    plan=None,
+):
+    """Run the counter system on loopback UDP for `duration` seconds,
+    recording a conformance trace at `path`; a `seed` injects a default
+    drop/duplicate/delay fault mix. Ports ascend with model index (the
+    conformance id mapping relies on that order)."""
+    from stateright_tpu.actor.spawn import (
+        json_serializer,
+        make_json_deserializer,
+        spawn,
+    )
+    from stateright_tpu.conformance import FaultPlan
+
+    ids = [Id.from_addr("127.0.0.1", base_port + i) for i in range(1 + client_count)]
+    actors = [(ids[0], CounterActor())]
+    for k in range(client_count):
+        actors.append(
+            (ids[1 + k], BumpClient(ids[0], retry_range=(0.05, 0.1)))
+        )
+    if plan is None and seed is not None:
+        plan = FaultPlan(
+            seed=seed, drop=0.05, duplicate=0.1, delay=0.05,
+            delay_range=(0.002, 0.02),
+        )
+    handle = spawn(
+        json_serializer,
+        make_json_deserializer(Bump, BumpOk),
+        actors,
+        background=True,
+        engine=engine,
+        record=path,
+        faults=plan,
+    )
+    time.sleep(duration)
+    handle.shutdown()
+    return path
+
+
+def conform_counter_trace(
+    path: str, client_count: Optional[int] = None, metrics=None
+):
+    """Check a recorded counter trace against `counter_model` and extract
+    its linearizability history. `client_count=None` infers it from the
+    trace's actor roster. Returns (ConformanceReport, tester)."""
+    from stateright_tpu.conformance import check_trace, load_trace, make_decoder
+
+    meta, events = load_trace(path)
+    if client_count is None:
+        client_count = max(len(meta.get("actors", [])) - 1, 1)
+    model = counter_model(client_count, Network.new_unordered_duplicating())
+    report = check_trace(
+        model, (meta, events), decode=make_decoder(Bump, BumpOk), metrics=metrics
+    )
+    return report, counter_history(events)
 
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     subcommand = argv[0] if argv else "check"
-    thread_count = int(argv[1]) if len(argv) > 1 else 2
-    print(f"Model checking increment with {thread_count} threads.")
+    thread_count = 2
+    if subcommand not in ("spawn-record", "conform") and len(argv) > 1:
+        thread_count = int(argv[1])
+    if subcommand not in ("spawn-record", "conform"):
+        print(f"Model checking increment with {thread_count} threads.")
     from examples._cli import print_coverage
 
     if subcommand == "check":
@@ -47,18 +326,51 @@ def main(argv=None):
         from stateright_tpu.analysis import analyze
 
         ok = True
-        for model in (Increment(thread_count), IncrementTensor(thread_count)):
+        for model in (
+            Increment(thread_count),
+            IncrementTensor(thread_count),
+            counter_model(thread_count),
+        ):
             report = analyze(model)
             print(report.format())
             ok = ok and report.ok
         if not ok:
             raise SystemExit(1)
+    elif subcommand == "check-actor":
+        checker = counter_model(thread_count).checker().spawn_bfs().report(
+            WriteReporter(sys.stdout)
+        )
+        print_coverage(checker)
+    elif subcommand == "spawn-record":
+        trace = argv[1] if len(argv) > 1 else "/tmp/counter_trace.jsonl"
+        duration = float(argv[2]) if len(argv) > 2 else 1.0
+        seed = int(argv[3]) if len(argv) > 3 else 7
+        print(
+            f"Running the counter system on loopback for {duration}s "
+            f"(fault seed {seed}); recording {trace}."
+        )
+        record_counter_demo(trace, duration=duration, seed=seed)
+        print(f"Recorded. Now try: python examples/increment.py conform {trace}")
+    elif subcommand == "conform":
+        if len(argv) < 2:
+            print("Usage: python examples/increment.py conform TRACE [CLIENT_COUNT]")
+            raise SystemExit(1)
+        client_count = int(argv[2]) if len(argv) > 2 else None
+        report, tester = conform_counter_trace(argv[1], client_count=client_count)
+        print(report.format(), end="")
+        serialized = tester.serialized_history()
+        verdict = "serializable" if serialized is not None else "NOT serializable"
+        print(f"history: {verdict} ({len(tester)} ops)")
+        if not report.ok:
+            raise SystemExit(1)
     else:
         print("USAGE:")
         print(
             "  python examples/increment.py "
-            "[check|check-sym|check-tpu|lint] [THREAD_COUNT]"
+            "[check|check-sym|check-tpu|check-actor|lint] [THREAD_COUNT]"
         )
+        print("  python examples/increment.py spawn-record [TRACE] [SECONDS] [SEED]")
+        print("  python examples/increment.py conform TRACE [CLIENT_COUNT]")
 
 
 if __name__ == "__main__":

@@ -226,9 +226,16 @@ def abd_model(
     )
 
 
-def spawn_info():
+ABD_MESSAGE_TYPES = (
+    Put, PutOk, Get, GetOk, Internal, Query, AckQuery, Record, AckRecord,
+)
+
+
+def spawn_info(record=None, faults=None, duration=None, engine="auto"):
     """Run a real 2-server ABD cluster over UDP
-    (linearizable-register.rs:257-284)."""
+    (linearizable-register.rs:257-284). `record`/`faults` thread through
+    to `spawn` (the CLI's ``--record``/``--faults`` flags); `duration`
+    runs in the background for that many seconds instead of blocking."""
     from stateright_tpu.actor import Id
     from stateright_tpu.actor.spawn import (
         json_serializer,
@@ -243,17 +250,107 @@ def spawn_info():
     print(f"$ nc -u localhost {port}")
     print('["Put", 1, "X"]')
     print('["Get", 2]')
-    spawn(
+    handle = spawn(
         json_serializer,
-        make_json_deserializer(
-            Put, PutOk, Get, GetOk, Internal, Query, AckQuery, Record,
-            AckRecord,
-        ),
+        make_json_deserializer(*ABD_MESSAGE_TYPES),
         [
             (ids[i], AbdActor([ids[j] for j in range(2) if j != i]))
             for i in range(2)
         ],
+        background=duration is not None,
+        engine=engine,
+        record=record,
+        faults=faults,
     )
+    if duration is not None:
+        import time
+
+        time.sleep(float(duration))
+        handle.shutdown()
+
+
+def record_abd_demo(
+    path: str,
+    duration: float = 1.5,
+    client_count: int = 1,
+    seed: Optional[int] = None,
+    engine: str = "auto",
+    base_port: int = 46200,
+    plan=None,
+):
+    """End-to-end demo: a 2-server ABD cluster plus register clients on
+    loopback UDP, recorded at `path`; a `seed` injects seeded
+    drop/duplicate faults — the mix the duplicating model network claims
+    to tolerate. Ports ascend with model index (servers first); the
+    conformance id mapping relies on that order."""
+    import time
+
+    from stateright_tpu.actor.spawn import (
+        json_serializer,
+        make_json_deserializer,
+        spawn,
+    )
+    from stateright_tpu.conformance import FaultPlan
+
+    ids = [
+        Id.from_addr("127.0.0.1", base_port + i) for i in range(2 + client_count)
+    ]
+    server_ids = ids[:2]
+    actors = [
+        (server_ids[i], AbdActor([server_ids[j] for j in range(2) if j != i]))
+        for i in range(2)
+    ]
+    for k in range(client_count):
+        actors.append(
+            (
+                ids[2 + k],
+                RegisterClient(
+                    put_count=1, server_count=2,
+                    index=2 + k, server_ids=server_ids,
+                ),
+            )
+        )
+    if plan is None and seed is not None:
+        plan = FaultPlan(seed=seed, drop=0.03, duplicate=0.12)
+    handle = spawn(
+        json_serializer,
+        make_json_deserializer(*ABD_MESSAGE_TYPES),
+        actors,
+        background=True,
+        engine=engine,
+        record=path,
+        faults=plan,
+    )
+    time.sleep(duration)
+    handle.shutdown()
+    return path
+
+
+def conform_abd_trace(path: str, client_count: Optional[int] = None, metrics=None):
+    """Check a recorded ABD trace against `abd_model` (on a duplicating
+    network, so injected duplicates are model-explainable) and extract its
+    linearizability history. `client_count=None` infers the topology from
+    the trace's actor roster. Returns (ConformanceReport, tester)."""
+    from stateright_tpu.conformance import (
+        check_trace,
+        load_trace,
+        make_decoder,
+        register_history,
+    )
+
+    meta, events = load_trace(path)
+    if client_count is None:
+        roster = meta.get("actors", [])
+        servers = sum(1 for a in roster if a.get("actor") == "AbdActor") or 2
+        client_count = max(len(roster) - servers, 0)
+    model = abd_model(client_count, 2, Network.new_unordered_duplicating())
+    report = check_trace(
+        model,
+        (meta, events),
+        decode=make_decoder(*ABD_MESSAGE_TYPES),
+        metrics=metrics,
+    )
+    return report, register_history(events)
 
 
 def main(argv=None):
@@ -265,6 +362,9 @@ def main(argv=None):
         build_model=lambda client_count, network: abd_model(client_count, 2, network),
         default_client_count=2,
         spawn_info=spawn_info,
+        conform_info=lambda path, client_count: conform_abd_trace(
+            path, client_count=client_count
+        ),
     )
 
 

@@ -51,12 +51,17 @@ class PingerActor(Actor):
 
     TIMERS = ("Even", "Odd", "NoOp")
 
-    def __init__(self, peer_ids):
+    def __init__(self, peer_ids, timeout_range=None):
+        # A real duration range keeps spawned actors from starving the
+        # datagram loop with zero-delay model timers.
         self.peer_ids = list(peer_ids)
+        self.timeout_range = (
+            timeout_range if timeout_range is not None else model_timeout()
+        )
 
     def on_start(self, id: Id, out: Out) -> PingerState:
         for timer in self.TIMERS:
-            out.set_timer(timer, model_timeout())
+            out.set_timer(timer, self.timeout_range)
         return PingerState(sent=0, received=0)
 
     def on_msg(self, id: Id, state: PingerState, src: Id, msg: Any, out: Out):
@@ -68,7 +73,7 @@ class PingerActor(Actor):
         return None
 
     def on_timeout(self, id: Id, state: PingerState, timer: Any, out: Out):
-        out.set_timer(timer, model_timeout())
+        out.set_timer(timer, self.timeout_range)
         if timer == "NoOp":
             return None
         parity = 0 if timer == "Even" else 1
@@ -98,6 +103,78 @@ def timers_model(server_count: int, network: Optional[Network] = None) -> ActorM
     )
 
 
+def record_timers_demo(
+    path: str,
+    server_count: int = 2,
+    duration: float = 0.4,
+    engine: str = "auto",
+    base_port: int = 46400,
+):
+    """Run the pingers on loopback UDP, recording a conformance trace.
+    `base_port` must be even: the actors pick peers by id parity, so the
+    port parity must match the dense model-index parity. No faults here —
+    the trace conforms against an Ordered model network, matching the
+    per-socket-pair FIFO that loopback UDP actually provides."""
+    import time
+
+    from stateright_tpu.actor.spawn import (
+        json_serializer,
+        make_json_deserializer,
+        spawn,
+    )
+
+    if base_port % 2 != 0:
+        raise ValueError("base_port must be even (peer choice is parity-based)")
+    ids = [Id.from_addr("127.0.0.1", base_port + i) for i in range(server_count)]
+    actors = [
+        (
+            ids[i],
+            PingerActor(
+                [ids[j] for j in range(server_count) if j != i],
+                timeout_range=(0.02, 0.05),
+            ),
+        )
+        for i in range(server_count)
+    ]
+    handle = spawn(
+        json_serializer,
+        make_json_deserializer(Ping, Pong),
+        actors,
+        background=True,
+        engine=engine,
+        record=path,
+    )
+    time.sleep(duration)
+    handle.shutdown()
+    return path
+
+
+def conform_timers_trace(path: str, server_count=None, metrics=None):
+    """Check a recorded timers trace against `timers_model` on an Ordered
+    network (`server_count=None` infers it from the trace's roster).
+    Returns (ConformanceReport, None) — no client history here."""
+    from stateright_tpu.conformance import check_trace, load_trace, make_decoder
+
+    meta, events = load_trace(path)
+    if server_count is None:
+        server_count = len(meta.get("actors", [])) or 2
+    model = timers_model(server_count, Network.new_ordered())
+    report = check_trace(
+        model, (meta, events), decode=make_decoder(Ping, Pong), metrics=metrics
+    )
+    return report, None
+
+
+def spawn_info(record=None, duration=None, engine="auto"):
+    """`spawn [--record TRACE] [--duration SECS] [--engine E]`."""
+    record_timers_demo(
+        record or "/tmp/timers_trace.jsonl",
+        duration=duration if duration is not None else 0.4,
+        engine=engine,
+    )
+    print(f"Recorded {record or '/tmp/timers_trace.jsonl'}")
+
+
 def main(argv=None):
     from examples._cli import example_main
 
@@ -107,6 +184,10 @@ def main(argv=None):
         build_model=lambda count, network: timers_model(count, network),
         default_client_count=2,
         default_network="unordered_duplicating",
+        spawn_info=spawn_info,
+        conform_info=lambda path, count: conform_timers_trace(
+            path, server_count=count
+        ),
     )
 
 

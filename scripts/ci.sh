@@ -3,14 +3,18 @@
 #
 #   scripts/ci.sh
 #
-# Four stages, fail-fast:
+# Five stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
 #   3. a stage-profiler smoke: one tiny device-engine run with
 #      `.stage_profile()` must populate the per-stage era breakdown and
 #      reconcile with the era wall time within 10%,
-#   4. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   4. a conformance smoke: the replicated counter runs ~1s on loopback
+#      UDP under seeded drop/duplicate/delay faults, records a trace, and
+#      the trace must conform against the actor model with ZERO
+#      divergences and yield a nonzero linearizable client history,
+#   5. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -53,6 +57,21 @@ assert stages, "stage_profile() produced no stage_* phases"
 era = tel["phase_ms"]["device_era"]
 assert era > 0 and abs(sum(stages.values()) - era) <= 0.1 * era, (stages, era)
 print(f"stage smoke OK: {len(stages)} stages attribute {era:.0f} ms of era time")
+PY
+
+echo "== conformance smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from examples.increment import conform_counter_trace, record_counter_demo
+
+path = "/tmp/_conform_smoke.jsonl"
+record_counter_demo(path, duration=1.0, seed=7, base_port=46100, client_count=2)
+report, tester = conform_counter_trace(path, client_count=2)
+print(report.format())
+assert not report.divergences, report.format()
+assert tester.serialized_history() is not None and len(tester) > 0, (
+    "expected a nonzero linearizable client history"
+)
+print(f"conformance smoke OK: {report.steps} steps, {len(tester)} history ops")
 PY
 
 echo "== tier-1 tests =="

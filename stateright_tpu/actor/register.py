@@ -102,16 +102,37 @@ class RegisterClient(Actor):
     reference does (register.rs:150-232) so histories stay comparable.
     """
 
-    def __init__(self, put_count: int, server_count: int):
+    def __init__(
+        self,
+        put_count: int,
+        server_count: int,
+        index: Optional[int] = None,
+        server_ids: Optional[list] = None,
+    ):
+        """In the model, the client's index IS its dense `Id` and server ids
+        are `Id(0..server_count)`. A real deployment's ids encode socket
+        addresses instead, so `index` (the client's model index) and
+        `server_ids` (the servers' deployment ids, model order) override
+        the derivations — behavior is unchanged when both are None."""
         self.put_count = put_count
         self.server_count = server_count
+        self.index = index
+        self.server_ids = list(server_ids) if server_ids is not None else None
 
     def name(self) -> str:
         return "Client"
 
+    def _index(self, id: Id) -> int:
+        return self.index if self.index is not None else int(id)
+
+    def _server(self, k: int) -> Id:
+        if self.server_ids is not None:
+            return Id(self.server_ids[k % self.server_count])
+        return Id(k % self.server_count)
+
     def on_start(self, id: Id, out: Out) -> RegisterClientState:
-        index = int(id)
-        if index < self.server_count:
+        index = self._index(id)
+        if self.index is None and index < self.server_count:
             raise ValueError(
                 "RegisterClient actors must be added to the model after servers."
             )
@@ -119,7 +140,7 @@ class RegisterClient(Actor):
             return RegisterClientState(awaiting=None, op_count=0)
         unique_request_id = index  # next will be 2 * index
         value = chr(ord("A") + index - self.server_count)
-        out.send(Id(index % self.server_count), Put(unique_request_id, value))
+        out.send(self._server(index), Put(unique_request_id, value))
         return RegisterClientState(awaiting=unique_request_id, op_count=1)
 
     def on_msg(
@@ -127,18 +148,18 @@ class RegisterClient(Actor):
     ) -> Optional[RegisterClientState]:
         if state.awaiting is None:
             return None
-        index = int(id)
+        index = self._index(id)
         if isinstance(msg, PutOk) and msg.request_id == state.awaiting:
             unique_request_id = (state.op_count + 1) * index
             if state.op_count < self.put_count:
                 value = chr(ord("Z") - (index - self.server_count))
                 out.send(
-                    Id((index + state.op_count) % self.server_count),
+                    self._server(index + state.op_count),
                     Put(unique_request_id, value),
                 )
             else:
                 out.send(
-                    Id((index + state.op_count) % self.server_count),
+                    self._server(index + state.op_count),
                     Get(unique_request_id),
                 )
             return RegisterClientState(

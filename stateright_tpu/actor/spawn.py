@@ -100,18 +100,39 @@ def json_deserializer(data: bytes) -> Any:
 # ---------------------------------------------------------------------------
 
 class _ActorLoop:
-    def __init__(self, id: Id, actor: Actor, serialize, deserialize, stop: threading.Event):
+    def __init__(
+        self,
+        id: Id,
+        actor: Actor,
+        serialize,
+        deserialize,
+        stop: threading.Event,
+        index: int = 0,
+        recorder=None,
+        injector=None,
+    ):
         self.id = Id(id)
         self.actor = actor
         self.serialize = serialize
         self.deserialize = deserialize
         self.stop = stop
+        self.index = index
+        self.recorder = recorder  # conformance.TraceRecorder or None
+        self.injector = injector  # conformance.FaultInjector or None
         # interrupt key -> absolute deadline; keys are ("t", timer) / ("r", random)
         self.next_interrupts: Dict[Any, float] = {}
         self.state: Any = None
         ip, port = addr_from_id(self.id)
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((ip, port))
+
+    def _raw_send(self, payload: bytes, addr) -> None:
+        try:
+            self.sock.sendto(payload, addr)
+        except OSError as e:
+            log.warning(
+                "actor %s: sendto %s failed: %s", self.id, addr, e
+            )  # fire-and-forget (spawn.rs:188-196)
 
     def _on_command(self, cmd) -> None:
         import random as _random
@@ -129,12 +150,18 @@ class _ActorLoop:
                     self.id, cmd.msg, cmd.dst, e,
                 )
                 return
-            try:
-                self.sock.sendto(payload, addr_from_id(cmd.dst))
-            except OSError as e:
-                log.warning(
-                    "actor %s: sendto %s failed: %s", self.id, cmd.dst, e
-                )  # fire-and-forget (spawn.rs:188-196)
+            addr = addr_from_id(cmd.dst)
+            if self.injector is not None:
+                self.injector.transmit(
+                    int(self.id),
+                    int(cmd.dst),
+                    payload,
+                    lambda data, _addr=addr: self._raw_send(data, _addr),
+                    recorder=self.recorder,
+                    actor_index=self.index,
+                )
+            else:
+                self._raw_send(payload, addr)
         elif isinstance(cmd, SetTimer):
             lo, hi = cmd.duration
             duration = _random.uniform(lo, hi) if lo < hi else lo
@@ -155,9 +182,17 @@ class _ActorLoop:
         for cmd in out.commands:
             self._on_command(cmd)
 
+    def _record(self, kind: str, out: Out, **fields) -> None:
+        # Recording precedes _dispatch so command events hit the trace
+        # before the wire: an actor's `send` line is causally ordered
+        # before the receiver's `deliver` line.
+        if self.recorder is not None:
+            self.recorder.record_handler(self.index, kind, self.state, out, **fields)
+
     def run(self) -> None:
         out = Out()
         self.state = self.actor.on_start(self.id, out)
+        self._record("init", out)
         self._dispatch(out)
 
         while not self.stop.is_set():
@@ -183,16 +218,20 @@ class _ActorLoop:
                     continue  # unparseable: ignore (spawn.rs:123-127)
                 src = Id.from_addr(*src_addr)
                 returned = self.actor.on_msg(self.id, self.state, src, msg, out)
+                event = ("deliver", {"src": int(src), "msg": msg})
             else:
                 del self.next_interrupts[min_key]  # interrupt consumed
                 kind, payload = min_key
                 if kind == "t":
                     returned = self.actor.on_timeout(self.id, self.state, payload, out)
+                    event = ("timeout", {"timer": payload})
                 else:
                     returned = self.actor.on_random(self.id, self.state, payload, out)
+                    event = ("random", {"value": payload})
 
             if returned is not None:
                 self.state = returned
+            self._record(event[0], out, **event[1])
             self._dispatch(out)
 
         self.sock.close()
@@ -204,6 +243,8 @@ def spawn(
     actors: List[Tuple[Any, Actor]],
     background: bool = False,
     engine: str = "auto",
+    record=None,
+    faults=None,
 ) -> "SpawnHandle":
     """Run each actor on its own thread with a UDP socket.
 
@@ -214,7 +255,22 @@ def spawn(
 
     `engine="native"` requires the C++ runtime extension; `"auto"` uses it
     when available, falling back to Python threads.
+
+    `record` (a path or `conformance.TraceRecorder`) captures every
+    handler execution and command as a JSONL TraceEvent stream checkable
+    via `conformance.check_trace`; `faults` (a `conformance.FaultPlan`,
+    ``"SEED[,drop[,dup[,delay[,reorder]]]]"`` spec string, or
+    `FaultInjector`) fuzzes outgoing datagrams with a seeded
+    deterministic schedule. Both work identically on either engine.
     """
+    recorder = injector = None
+    if record is not None or faults is not None:
+        # Imported lazily: conformance imports this module's serde helpers.
+        from ..conformance import as_injector, as_recorder
+
+        recorder = as_recorder(record)
+        injector = as_injector(faults)
+
     resolved: List[Tuple[Id, Actor]] = []
     for id_or_addr, actor in actors:
         if isinstance(id_or_addr, tuple):
@@ -225,22 +281,37 @@ def spawn(
     if engine in ("auto", "native"):
         native = _native_runtime()
         if native is not None:
-            return native.spawn(serialize, deserialize, resolved, background)
+            return native.spawn(
+                serialize,
+                deserialize,
+                resolved,
+                background,
+                recorder=recorder,
+                injector=injector,
+            )
         if engine == "native":
             raise RuntimeError(
                 "native spawn engine requested but the C++ runtime extension "
                 "is not built (run: python -m stateright_tpu.native.build)"
             )
 
+    if recorder is not None:
+        recorder.attach(resolved, engine="python")
     stop = threading.Event()
-    loops = [_ActorLoop(id, actor, serialize, deserialize, stop) for id, actor in resolved]
+    loops = [
+        _ActorLoop(
+            id, actor, serialize, deserialize, stop,
+            index=i, recorder=recorder, injector=injector,
+        )
+        for i, (id, actor) in enumerate(resolved)
+    ]
     threads = [
         threading.Thread(target=loop.run, name=f"actor-{int(loop.id)}", daemon=True)
         for loop in loops
     ]
     for t in threads:
         t.start()
-    handle = SpawnHandle(stop, threads, loops)
+    handle = SpawnHandle(stop, threads, loops, recorder=recorder, injector=injector)
     if not background:
         try:
             while any(t.is_alive() for t in threads):
@@ -261,10 +332,12 @@ def _native_runtime():
 class SpawnHandle:
     """Controls a running actor deployment (background mode)."""
 
-    def __init__(self, stop: threading.Event, threads, loops):
+    def __init__(self, stop: threading.Event, threads, loops, recorder=None, injector=None):
         self._stop = stop
         self._threads = threads
         self._loops = loops
+        self._recorder = recorder
+        self._injector = injector
 
     def state(self, id) -> Any:
         """Peek at an actor's current state (for tests/debugging)."""
@@ -277,3 +350,9 @@ class SpawnHandle:
         self._stop.set()
         for t in self._threads:
             t.join(timeout)
+        # Injector first: it may still flush held datagrams whose deliveries
+        # can no longer be recorded, but the trace file must be sealed last.
+        if self._injector is not None:
+            self._injector.close()
+        if self._recorder is not None:
+            self._recorder.close()

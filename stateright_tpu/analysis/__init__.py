@@ -3,7 +3,7 @@
 TLC-style "sanity before search" (the reference trusts user models
 completely; this framework does not have to). `analyze(model)` replays
 the model's callbacks over a bounded breadth-first sample of its own
-state space and runs four rule families:
+state space and runs five rule families:
 
   1. determinism/purity  (STR1xx, analysis/determinism.py) — hidden RNG,
      set-iteration-order nondeterminism, in-place mutation of the input
@@ -17,7 +17,11 @@ state space and runs four rule families:
      `eventually` without reachable terminal states;
   4. symmetry soundness (STR4xx, analysis/symmetry.py) —
      `representative()` idempotence, property preservation, and
-     host/device canonicalizer agreement.
+     host/device canonicalizer agreement;
+  5. spawnability (STR5xx, analysis/spawnability.py; ActorModels) —
+     sampled in-flight messages must survive the `json_serializer`
+     wire round-trip, or a deployed run silently drops/corrupts them
+     (and trace conformance reports spurious divergences).
 
 Wire-in points:
 
@@ -39,7 +43,8 @@ import numpy as np
 
 from ..core import Model
 from ..tensor import TensorModel, TensorModelAdapter
-from . import determinism, device, properties, symmetry
+from ..actor.model import ActorModel
+from . import determinism, device, properties, spawnability, symmetry
 from .diagnostics import (
     AnalysisReport,
     Diagnostic,
@@ -60,7 +65,7 @@ __all__ = [
     "sample_states",
 ]
 
-ALL_FAMILIES = ("determinism", "device", "properties", "symmetry")
+ALL_FAMILIES = ("determinism", "device", "properties", "symmetry", "spawn")
 
 # Device-rule batch width: tracing/executing step_lanes on more rows buys
 # no additional coverage for shape/dtype/divergence findings, and keeps
@@ -143,4 +148,6 @@ def analyze(
             rows=rows,
             orbit_fn=orbit_fn,
         )
+    if "spawn" in families and isinstance(host, ActorModel):
+        spawnability.run(host, sample, report)
     return report

@@ -9,6 +9,7 @@ group by rule family:
   ``STR2xx``  device (jit/vmap/encoding) compatibility of TensorModels
   ``STR3xx``  property well-formedness
   ``STR4xx``  symmetry-reduction soundness
+  ``STR5xx``  spawnability (wire round-trip) of ActorModel messages
 
 The full code -> meaning -> fix catalog lives in `analysis/README.md`
 (mirroring the obs metric-name catalog in obs/metrics.py).

@@ -1,0 +1,124 @@
+"""STR5xx — spawnability: do the model's messages survive the wire?
+
+An `ActorModel` that checks clean can still fail the moment it is
+deployed with `actor.spawn`: the default wire format
+(`json_serializer` / `make_json_deserializer`) encodes dataclasses as
+``["TypeName", field...]`` and everything else as plain JSON — so a
+message carrying a set, frozenset, dict, or other non-JSON payload
+raises inside the actor loop (datagram silently dropped), and a message
+carrying a LIST field decodes back as a TUPLE (JSON has no distinction;
+the deserializer picks tuple because handlers compare tuple-typed fields
+like paxos ballots). These rules round-trip the messages actually
+observed in flight on the sampled state space and flag the types that do
+not come back equal — BEFORE a live run spends an afternoon on it.
+
+Trace conformance (conformance/check.py) has the same dependency: it
+matches recorded wire messages against model envelopes through the same
+encoding, so an STR5xx finding also predicts bogus `unexplained-deliver`
+divergences.
+
+Codes:
+  STR501  a message raises during json_serializer/deserializer round-trip
+  STR502  a message round-trips without raising but comes back UNEQUAL
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+from ..actor.model import ActorModel
+from ..actor.spawn import json_serializer, make_json_deserializer
+from .diagnostics import AnalysisReport, Severity
+from .sampling import Sample
+
+# Round-tripping more than this many distinct in-flight messages buys no
+# new findings (one finding per message TYPE per code) and keeps the
+# pre-flight cheap enough for strict mode.
+_MESSAGE_CAP = 64
+
+
+def _collect_types(value: Any, out: Dict[str, type]) -> None:
+    """Every dataclass type reachable from `value`, by name — the set the
+    deployment's `make_json_deserializer(...)` would need to know."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out.setdefault(type(value).__name__, type(value))
+        for f in dataclasses.fields(value):
+            _collect_types(getattr(value, f.name), out)
+    elif isinstance(value, (list, tuple, set, frozenset)):
+        for v in value:
+            _collect_types(v, out)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _collect_types(k, out)
+            _collect_types(v, out)
+
+
+def run(model: ActorModel, sample: Sample, report: AnalysisReport) -> None:
+    report.families_run.append("spawn")
+
+    # The messages actually observed in flight across the sample — the
+    # honest population a spawned run would put on the wire.
+    messages: List[Any] = []
+    seen = set()
+    for state in sample.states:
+        network = getattr(state, "network", None)
+        if network is None:
+            continue
+        for env in network.iter_all():
+            key = repr(env.msg)
+            if key in seen:
+                continue
+            seen.add(key)
+            messages.append(env.msg)
+            if len(messages) >= _MESSAGE_CAP:
+                break
+        if len(messages) >= _MESSAGE_CAP:
+            break
+
+    if not messages:
+        return
+
+    types: Dict[str, type] = {}
+    for msg in messages:
+        _collect_types(msg, types)
+    decode = make_json_deserializer(*types.values())
+
+    loc = type(model).__name__
+    flagged_raise = set()
+    flagged_unequal = set()
+    for msg in messages:
+        tname = type(msg).__name__
+        try:
+            back = decode(json_serializer(msg))
+        except BaseException as e:  # noqa: BLE001
+            if tname not in flagged_raise:
+                report.add(
+                    "STR501",
+                    Severity.ERROR,
+                    f"message {msg!r} does not survive the spawn wire "
+                    f"format: json_serializer round-trip raised "
+                    f"{type(e).__name__}: {e}; a live run would drop these "
+                    "datagrams silently",
+                    f"{loc}.{tname}",
+                    "restrict message fields to JSON-able values "
+                    "(dataclasses, tuples, ints, strings) — sets, dicts, "
+                    "and arbitrary objects do not serialize",
+                )
+                flagged_raise.add(tname)
+            continue
+        if back != msg and tname not in flagged_unequal:
+            report.add(
+                "STR502",
+                Severity.ERROR,
+                f"message {msg!r} round-trips the spawn wire format as "
+                f"{back!r} (unequal); deployed handlers would see a "
+                "different value than the checker verified — and trace "
+                "conformance would report spurious divergences",
+                f"{loc}.{tname}",
+                "use tuples instead of lists in message fields (JSON "
+                "cannot distinguish them; the deserializer decodes "
+                "sequences as tuples)",
+                round_trip=repr(back),
+            )
+            flagged_unequal.add(tname)

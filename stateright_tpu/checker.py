@@ -266,11 +266,14 @@ class CheckerBuilder:
 
         return ShardedBfsChecker(self, **kw)
 
-    def serve(self, address: str):
-        """Start the Explorer web service. Reference: checker.rs:144-151."""
+    def serve(self, address: str, trace=None):
+        """Start the Explorer web service. Reference: checker.rs:144-151.
+
+        `trace` attaches a recorded conformance trace (a JSONL path from
+        `spawn(..., record=...)`), served at ``GET /trace``."""
         from .explorer.server import serve
 
-        return serve(self, address)
+        return serve(self, address, trace=trace)
 
 
 class Checker:

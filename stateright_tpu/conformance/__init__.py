@@ -1,0 +1,38 @@
+"""Trace conformance: record real-network runs and check them against the model.
+
+The dual-execution story's missing half. `spawn(..., record=path)` makes
+both engines emit a JSONL `TraceEvent` stream (events.py / record.py);
+`spawn(..., faults=FaultPlan(...))` fuzzes the deployment's links with a
+seeded deterministic drop/duplicate/delay/reorder schedule (faults.py);
+`check_trace(model, path)` replays the recording against the
+`ActorModel` transition relation and reports divergences with
+field-level forensics (check.py); `register_history` / `extract_history`
+feed the recorded client operations through the semantics/ testers
+(history.py). See conformance/README.md for the schema and the
+divergence-kind catalog, and `examples/_cli.py` for the CLI surface
+(``spawn --record/--faults`` and ``conform``).
+"""
+
+from .check import ConformanceReport, Divergence, check_trace
+from .events import TraceError, jsonable, load_trace, make_decoder
+from .faults import FaultDecision, FaultInjector, FaultPlan, as_injector
+from .history import extract_history, register_history
+from .record import TraceRecorder, as_recorder
+
+__all__ = [
+    "ConformanceReport",
+    "Divergence",
+    "FaultDecision",
+    "FaultInjector",
+    "FaultPlan",
+    "TraceError",
+    "TraceRecorder",
+    "as_injector",
+    "as_recorder",
+    "check_trace",
+    "extract_history",
+    "jsonable",
+    "load_trace",
+    "make_decoder",
+    "register_history",
+]

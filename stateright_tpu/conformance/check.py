@@ -1,0 +1,433 @@
+"""The conformance checker: replay a recorded trace against the model.
+
+`check_trace(model, trace)` walks the trace's handler events in wire
+order and matches each against the `ActorModel` transition relation:
+
+  - a ``deliver`` must correspond to an enabled `Deliver` action — a
+    deliverable envelope with the same src/dst/payload; a ``timeout`` to
+    an armed model timer; a ``random`` to a pending `SelectRandom`;
+  - the commands the deployment emitted (the event's ``send`` /
+    ``timer_set`` / ... children) must equal the commands the model's
+    handler emits for that step;
+  - the recorded post-handler actor state must equal the model's.
+
+Some real-world events are legitimate *stutters* — steps the model
+prunes from its graph but that its semantics explain: a no-op delivery
+the model collapses (`is_no_op`, e.g. a duplicated datagram hitting an
+idempotent handler), or a timeout whose only effect is re-arming itself
+(`is_no_op_with_timer`). These count as ``stutters``, not divergences.
+
+Everything else is a `Divergence`:
+
+  ``unexplained-deliver``   delivered message matches no deliverable model
+                            envelope, and replaying it is not a no-op
+  ``unexplained-timeout``   fired timer is not armed in the model state
+  ``unexplained-random``    resolved value matches no pending choice
+  ``command-mismatch``      deployment sent/armed something the model's
+                            handler would not (or vice versa)
+  ``state-mismatch``        post-handler state differs from the model's —
+                            reported with a field-level diff and a
+                            `Path.explain` narrative of the steps leading
+                            up to it
+  ``decode-error``          a recorded payload no decoder recognizes
+
+Divergence-free means: the deployment's observed behavior is a path
+through (a stuttering extension of) the model's state graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..actor.base import Out, is_no_op
+from ..actor.ids import Id
+from ..actor.model import Deliver, SelectRandom, Timeout
+from ..obs.metrics import MetricsRegistry
+from ..path import Path
+from .events import TraceError, command_views, jsonable, load_trace
+
+
+@dataclasses.dataclass
+class Divergence:
+    """One point where the deployment left the model's behavior."""
+
+    kind: str
+    actor: int
+    seq: int
+    message: str
+    diff: Dict[str, list] = dataclasses.field(default_factory=dict)
+    narrative: str = ""
+
+    def format(self) -> str:
+        lines = [f"[{self.kind}] actor={self.actor} seq={self.seq}: {self.message}"]
+        for field, pair in self.diff.items():
+            lines.append(f"    {field}: model={pair[0]!r} trace={pair[1]!r}")
+        if self.narrative:
+            lines.append("    model-side steps leading here:")
+            for ln in self.narrative.rstrip("\n").splitlines():
+                lines.append(f"      {ln}")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class ConformanceReport:
+    """The verdict of one `check_trace` run."""
+
+    events: int = 0
+    steps: int = 0
+    stutters: int = 0
+    faults: int = 0
+    boundary_exits: int = 0
+    divergences: List[Divergence] = dataclasses.field(default_factory=list)
+    truncated: bool = False
+    history: Any = None
+    meta: dict = dataclasses.field(default_factory=dict)
+    final_state: Any = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergences
+
+    def format(self) -> str:
+        verdict = "OK" if self.ok else f"DIVERGED ({len(self.divergences)})"
+        lines = [
+            f"conformance: {verdict} — {self.events} events, "
+            f"{self.steps} model steps, {self.stutters} stutters, "
+            f"{self.faults} injected faults, "
+            f"{self.boundary_exits} boundary exits"
+        ]
+        for d in self.divergences:
+            lines.append("  " + d.format().replace("\n", "\n  "))
+        if self.truncated:
+            lines.append("  ... divergence list truncated")
+        return "\n".join(lines) + "\n"
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "events": self.events,
+            "steps": self.steps,
+            "stutters": self.stutters,
+            "faults": self.faults,
+            "boundary_exits": self.boundary_exits,
+            "truncated": self.truncated,
+            "divergences": [dataclasses.asdict(d) for d in self.divergences],
+        }
+
+
+def check_trace(
+    model,
+    trace,
+    decode=None,
+    metrics: Optional[MetricsRegistry] = None,
+    max_divergences: int = 25,
+    keep_steps: int = 8,
+) -> ConformanceReport:
+    """Replay `trace` (a path, or a `load_trace` result) against `model`.
+
+    `decode` (from `make_decoder`) lets the checker re-execute handlers on
+    recorded payloads that match no in-flight model envelope, to tell a
+    harmless redelivery stutter from a genuinely unexplained message.
+    `metrics` (created if None) receives the ``conformance_*`` counters.
+    """
+    if isinstance(trace, (str, bytes)) or hasattr(trace, "__fspath__"):
+        meta, events = load_trace(trace)
+    else:
+        meta, events = trace
+    if metrics is None:
+        metrics = MetricsRegistry()
+    roster = meta.get("actors", [])
+    if len(roster) != len(model.actors):
+        raise TraceError(
+            f"trace has {len(roster)} actors but the model has "
+            f"{len(model.actors)} — not the same system"
+        )
+
+    report = ConformanceReport(meta=meta)
+    cur = model.init_states()[0]
+    recent: deque = deque(maxlen=keep_steps)
+    children: Dict[Tuple[int, int], List[dict]] = {}
+    for ev in events:
+        if "cause" in ev:
+            children.setdefault((ev["actor"], ev["cause"]), []).append(ev)
+
+    def diverge(kind, ev, message, diff=None, narrative=""):
+        if len(report.divergences) >= max_divergences:
+            report.truncated = True
+            return
+        report.divergences.append(
+            Divergence(
+                kind=kind,
+                actor=ev.get("actor", -1),
+                seq=ev.get("seq", -1),
+                message=message,
+                diff=diff or {},
+                narrative=narrative,
+            )
+        )
+
+    def narrate() -> str:
+        try:
+            return Path(list(recent) + [(cur, None)]).explain(model)
+        except Exception:
+            return ""
+
+    def check_children(ev, out) -> None:
+        expected = command_views(out.commands)
+        actual = [
+            _child_view(c) for c in children.get((ev["actor"], ev["seq"]), [])
+        ]
+        if expected != actual:
+            diverge(
+                "command-mismatch",
+                ev,
+                f"{ev['kind']} handler commands differ",
+                diff={"commands": [expected, actual]},
+                narrative=narrate(),
+            )
+
+    def check_state(ev) -> None:
+        index = ev["actor"]
+        model_enc = jsonable(cur.actor_states[index])
+        if model_enc != ev["state"]:
+            diff = _json_diff(cur.actor_states[index], model_enc, ev["state"])
+            diverge(
+                "state-mismatch",
+                ev,
+                f"actor {index} post-{ev['kind']} state differs from the model",
+                diff=diff,
+                narrative=narrate(),
+            )
+
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "fault":
+            report.faults += 1
+            continue
+        if "cause" in ev:  # command child; handled with its parent
+            continue
+        report.events += 1
+        index = ev["actor"]
+
+        if kind == "init":
+            out = Out()
+            try:
+                model.actors[index].on_start(Id(index), out)
+            except Exception as e:
+                diverge("unexplained-deliver", ev, f"on_start replay raised: {e!r}")
+                continue
+            check_children(ev, out)
+            check_state(ev)
+            continue
+
+        if kind == "deliver":
+            env = None
+            for cand in cur.network.iter_deliverable():
+                if (
+                    int(cand.dst) == index
+                    and int(cand.src) == ev.get("src")
+                    and jsonable(cand.msg) == ev["msg"]
+                ):
+                    env = cand
+                    break
+            if env is not None:
+                action = Deliver(env.src, env.dst, env.msg)
+                out = Out()
+                try:
+                    model.actors[index].on_msg(
+                        env.dst, cur.actor_states[index], env.src, env.msg, out
+                    )
+                    nxt = model.next_state(cur, action)
+                except Exception as e:
+                    diverge("unexplained-deliver", ev, f"on_msg replay raised: {e!r}")
+                    continue
+                check_children(ev, out)
+                if nxt is None:
+                    report.stutters += 1  # model prunes this no-op delivery
+                else:
+                    recent.append((cur, action))
+                    cur = nxt
+                    report.steps += 1
+                check_state(ev)
+                continue
+            # No matching in-flight envelope. Replay the payload: a no-op
+            # redelivery (duplicate/late datagram) is a stutter; anything
+            # with an effect is a message the model cannot explain.
+            replayed = False
+            if decode is not None:
+                try:
+                    msg = decode(ev["msg"])
+                except Exception as e:
+                    diverge("decode-error", ev, f"cannot decode payload: {e!r}")
+                    continue
+                out = Out()
+                try:
+                    returned = model.actors[index].on_msg(
+                        Id(index),
+                        cur.actor_states[index],
+                        Id(ev.get("src", 0)),
+                        msg,
+                        out,
+                    )
+                    replayed = True
+                except Exception:
+                    replayed = False
+                if replayed and is_no_op(returned, out):
+                    report.stutters += 1
+                    check_children(ev, out)
+                    check_state(ev)
+                    continue
+            in_flight = [
+                f"{int(e.src)}->{int(e.dst)}: {jsonable(e.msg)}"
+                for e in cur.network.iter_deliverable()
+            ]
+            diverge(
+                "unexplained-deliver",
+                ev,
+                f"delivered message {ev['msg']!r} from {ev.get('src')} matches "
+                f"no deliverable model envelope (and is not a no-op "
+                f"redelivery); deliverable now: {in_flight or 'none'}",
+                narrative=narrate(),
+            )
+            continue
+
+        if kind == "timeout":
+            timer = None
+            for cand in cur.timers_set[index]:
+                if jsonable(cand) == ev["timer"]:
+                    timer = cand
+                    break
+            if timer is None:
+                diverge(
+                    "unexplained-timeout",
+                    ev,
+                    f"timer {ev['timer']!r} fired but is not armed in the "
+                    f"model (armed: {[jsonable(t) for t in cur.timers_set[index]]})",
+                    narrative=narrate(),
+                )
+                continue
+            action = Timeout(Id(index), timer)
+            out = Out()
+            try:
+                model.actors[index].on_timeout(
+                    Id(index), cur.actor_states[index], timer, out
+                )
+                nxt = model.next_state(cur, action)
+            except Exception as e:
+                diverge("unexplained-timeout", ev, f"on_timeout replay raised: {e!r}")
+                continue
+            check_children(ev, out)
+            if nxt is None:
+                report.stutters += 1  # pure re-arm, pruned by the model
+            else:
+                recent.append((cur, action))
+                cur = nxt
+                report.steps += 1
+            check_state(ev)
+            continue
+
+        if kind == "random":
+            action = None
+            for key in sorted(cur.random_choices[index].map):
+                for choice in cur.random_choices[index].map[key]:
+                    if jsonable(choice) == ev["value"]:
+                        action = SelectRandom(Id(index), key, choice)
+                        break
+                if action is not None:
+                    break
+            if action is None:
+                diverge(
+                    "unexplained-random",
+                    ev,
+                    f"random value {ev['value']!r} matches no pending choice",
+                    narrative=narrate(),
+                )
+                continue
+            out = Out()
+            try:
+                model.actors[index].on_random(
+                    Id(index), cur.actor_states[index], action.random, out
+                )
+                nxt = model.next_state(cur, action)
+            except Exception as e:
+                diverge("unexplained-random", ev, f"on_random replay raised: {e!r}")
+                continue
+            check_children(ev, out)
+            if nxt is not None:
+                recent.append((cur, action))
+                cur = nxt
+                report.steps += 1
+            check_state(ev)
+            continue
+
+        diverge("decode-error", ev, f"unknown TraceEvent kind {kind!r}")
+
+    if not model.within_boundary(cur):
+        report.boundary_exits += 1
+    report.history = cur.history
+    report.final_state = cur
+
+    metrics.inc("conformance_events", report.events)
+    metrics.inc("conformance_steps", report.steps)
+    metrics.inc("conformance_stutters", report.stutters)
+    metrics.inc("conformance_faults", report.faults)
+    metrics.inc("conformance_divergences", len(report.divergences))
+    try:
+        metrics.set_gauge("conformance_history_ops", len(report.history))
+    except TypeError:
+        pass
+    return report
+
+
+# -- internals ----------------------------------------------------------------
+
+
+def _child_view(ev: dict) -> list:
+    kind = ev["kind"]
+    if kind == "send":
+        return ["send", ev.get("dst"), ev.get("msg")]
+    if kind in ("timer_set", "timer_cancel"):
+        return [kind, ev.get("timer")]
+    if kind == "choose":
+        return ["choose", ev.get("key"), ev.get("choices")]
+    return [kind]
+
+
+def _json_diff(obj: Any, a: Any, b: Any, prefix: str = "") -> Dict[str, list]:
+    """Field-level diff of two canonical encodings of the same state.
+
+    `obj` is the model-side value whose structure names the paths: a
+    dataclass contributes ``TypeName.field`` segments, sequences ``[i]``.
+    Returns path -> [model_encoding, trace_encoding] leaves.
+    """
+    if a == b:
+        return {}
+    if (
+        dataclasses.is_dataclass(obj)
+        and not isinstance(obj, type)
+        and isinstance(a, list)
+        and isinstance(b, list)
+        and len(a) == len(b)
+        and a[:1] == b[:1]
+        and len(a) == 1 + len(dataclasses.fields(obj))
+    ):
+        out: Dict[str, list] = {}
+        name = type(obj).__name__
+        for i, f in enumerate(dataclasses.fields(obj), start=1):
+            seg = f"{prefix}.{name}.{f.name}" if prefix else f"{name}.{f.name}"
+            out.update(_json_diff(getattr(obj, f.name), a[i], b[i], seg))
+        return out
+    if (
+        isinstance(obj, (list, tuple))
+        and isinstance(a, list)
+        and isinstance(b, list)
+        and len(a) == len(b)
+        and len(obj) == len(a)
+    ):
+        out = {}
+        for i, sub in enumerate(obj):
+            out.update(_json_diff(sub, a[i], b[i], f"{prefix}[{i}]"))
+        return out
+    return {prefix or "state": [a, b]}

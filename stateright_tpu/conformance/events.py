@@ -1,0 +1,158 @@
+"""TraceEvent schema: canonical JSON encoding of real-network runs.
+
+A recorded trace is JSONL — one standalone JSON object per line, flushed
+as written (the obs/trace.py discipline), so a killed deployment still
+leaves a parseable prefix. Line one is the ``meta`` record naming the
+deployment (engine, actor roster); every following line is one
+`TraceEvent` (see conformance/README.md for the full catalog):
+
+  handler events (carry ``actor``, per-actor monotonic ``seq``, ``ts``,
+  and the actor's post-handler ``state``):
+
+    ``init``     on_start ran
+    ``deliver``  a datagram was deserialized and handled (``src``, ``msg``)
+    ``timeout``  a timer fired (``timer``)
+    ``random``   a pending random choice resolved (``value``)
+
+  command events (children of the handler event named by ``cause``):
+
+    ``send`` / ``timer_set`` / ``timer_cancel`` / ``choose``
+
+  fault events (from conformance/faults.py): ``fault`` with the decision
+  kind, link, and per-link sequence number.
+
+Values are encoded with `jsonable`, an extension of the spawn wire
+encoding (`actor/spawn.py:_to_jsonable`) that additionally handles
+sets/frozensets and dicts (actor *states* contain them even though wire
+messages may not) and — crucially — remaps deployment `Id`s back to
+dense model indices. A deployment id packs (ip << 16) | port, so every
+real id is >= 2**16; remapping by value therefore never collides with
+legitimate small integers in the payload, and messages that embed actor
+ids (ABD's ``seq=(clock, id)`` sequencers, requester ids, ...) compare
+equal to their model-world counterparts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..actor.base import CancelTimer, ChooseRandom, Send, SetTimer
+from ..actor.spawn import _from_jsonable
+
+
+class TraceError(Exception):
+    """A trace file that cannot be parsed (not a divergence — a broken file)."""
+
+
+def jsonable(value: Any, id_map: Optional[Dict[int, int]] = None):
+    """Canonical JSON view of a message/state value.
+
+    `id_map` maps deployment ids (as ints) to dense model indices; every
+    int found in the map is remapped, wherever it is nested. Encoding
+    rules beyond `_to_jsonable`: set/frozenset -> ``{"set": [...]}`` with
+    deterministically sorted elements, dict -> ``{"map": [[k, v], ...]}``
+    sorted by key, unknown objects -> ``{"repr": "..."}``. JSON objects
+    never arise from the wire encoding, so these wrappers are unambiguous.
+    """
+    if isinstance(value, bool) or value is None or isinstance(value, (float, str)):
+        return value
+    if isinstance(value, int):
+        iv = int(value)  # normalizes Id subclasses
+        if id_map and iv in id_map:
+            return id_map[iv]
+        return iv
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return [type(value).__name__] + [
+            jsonable(getattr(value, f.name), id_map)
+            for f in dataclasses.fields(value)
+        ]
+    if isinstance(value, (list, tuple)):
+        return [jsonable(v, id_map) for v in value]
+    if isinstance(value, (set, frozenset)):
+        encoded = [jsonable(v, id_map) for v in value]
+        encoded.sort(key=lambda e: json.dumps(e, sort_keys=True))
+        return {"set": encoded}
+    if isinstance(value, dict):
+        pairs = [
+            [jsonable(k, id_map), jsonable(v, id_map)] for k, v in value.items()
+        ]
+        pairs.sort(key=lambda kv: json.dumps(kv[0], sort_keys=True))
+        return {"map": pairs}
+    return {"repr": repr(value)}
+
+
+def command_views(commands, id_map: Optional[Dict[int, int]] = None) -> List[list]:
+    """The comparable view of an `Out`'s commands — the exact shape the
+    recorder emits as command events, so trace children and model replay
+    compare with ``==``. Timer durations are deliberately excluded: they
+    are real-world scheduling detail the model abstracts away."""
+    views: List[list] = []
+    for cmd in commands:
+        if isinstance(cmd, Send):
+            dst = int(cmd.dst)
+            if id_map and dst in id_map:
+                dst = id_map[dst]
+            views.append(["send", dst, jsonable(cmd.msg, id_map)])
+        elif isinstance(cmd, SetTimer):
+            views.append(["timer_set", jsonable(cmd.timer, id_map)])
+        elif isinstance(cmd, CancelTimer):
+            views.append(["timer_cancel", jsonable(cmd.timer, id_map)])
+        elif isinstance(cmd, ChooseRandom):
+            views.append(
+                ["choose", cmd.key, [jsonable(c, id_map) for c in cmd.choices]]
+            )
+    return views
+
+
+def make_decoder(*message_types) -> Callable[[Any], Any]:
+    """Jsonable -> model-domain message, recognizing ["TypeName", ...] for
+    the given dataclass types (the conformance-side twin of
+    `make_json_deserializer`; JSON lists decode to tuples for the same
+    reason)."""
+    by_name = {t.__name__: t for t in message_types}
+
+    def decode(value: Any) -> Any:
+        return _from_jsonable(value, by_name)
+
+    return decode
+
+
+HANDLER_KINDS = ("init", "deliver", "timeout", "random")
+COMMAND_KINDS = ("send", "timer_set", "timer_cancel", "choose")
+
+
+def load_trace(path: str) -> Tuple[dict, List[dict]]:
+    """Parse a recorded JSONL trace into ``(meta, events)``.
+
+    Raises `TraceError` on malformed JSON or a missing/invalid meta line.
+    A trailing partial line (killed deployment) is tolerated.
+    """
+    meta: Optional[dict] = None
+    events: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        raise TraceError(f"cannot read trace {path!r}: {e}") from e
+    for lineno, line in enumerate(lines, start=1):
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError as e:
+            if lineno == len(lines):
+                break  # torn final line: the deployment was killed mid-write
+            raise TraceError(f"{path}:{lineno}: malformed JSON: {e}") from e
+        if not isinstance(record, dict) or "kind" not in record:
+            raise TraceError(f"{path}:{lineno}: not a TraceEvent object")
+        if record["kind"] == "meta":
+            if meta is not None:
+                raise TraceError(f"{path}:{lineno}: duplicate meta record")
+            meta = record
+        else:
+            events.append(record)
+    if meta is None:
+        raise TraceError(f"{path}: missing meta record (is this a trace file?)")
+    return meta, events

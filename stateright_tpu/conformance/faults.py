@@ -1,0 +1,265 @@
+"""Deterministic fault injection for the spawn socket layer.
+
+A `FaultPlan` is a seeded, *pure* policy: the fate of the n-th datagram
+on the (src, dst) link is a function of (seed, src, dst, n) alone —
+independent of wall-clock timing or thread interleaving — so the same
+plan replays the same drop/duplicate/delay/reorder schedule run after
+run (locked by tests/test_conformance.py). The fault kinds mirror what
+`actor/network.py` lets the model claim to tolerate:
+
+  drop       the datagram never reaches the socket (lossy network)
+  duplicate  sent twice back-to-back (duplicating network)
+  delay      sent after a seeded pause (unordered network)
+  reorder    held until the link's next datagram has been sent
+             (unordered network; a 0.2s failsafe flush bounds the hold
+             when the link goes quiet)
+
+`FaultInjector` wraps an engine's raw send callable. Both engines route
+every outgoing datagram through `transmit(src, dst, payload, send)`;
+the injector applies the plan's decision and records it as a ``fault``
+TraceEvent. Delayed/held sends fire from a single scheduler thread —
+safe because both engines' send paths are thread-safe (`socket.sendto`,
+and `srn_send` which no-ops after `srn_stop`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+FAULT_KINDS = ("drop", "duplicate", "delay", "reorder", "deliver")
+
+_REORDER_FLUSH_SECS = 0.2
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultDecision:
+    """What the plan decided for one datagram."""
+
+    kind: str  # one of FAULT_KINDS
+    delay: float = 0.0  # seconds; only meaningful for kind == "delay"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Seeded per-datagram fault policy. Probabilities are independent
+    slices of one uniform draw (so they must sum to <= 1); whatever is
+    left delivers cleanly."""
+
+    seed: int = 0
+    drop: float = 0.0
+    duplicate: float = 0.0
+    delay: float = 0.0
+    reorder: float = 0.0
+    delay_range: Tuple[float, float] = (0.005, 0.05)
+
+    def __post_init__(self):
+        total = self.drop + self.duplicate + self.delay + self.reorder
+        if total > 1.0 + 1e-9:
+            raise ValueError(f"fault probabilities sum to {total} > 1")
+
+    def decide(self, src: int, dst: int, n: int) -> FaultDecision:
+        """The fate of the n-th datagram on the src->dst link. Pure."""
+        rng = random.Random(f"{self.seed}|{int(src)}|{int(dst)}|{int(n)}")
+        r = rng.random()
+        edge = self.drop
+        if r < edge:
+            return FaultDecision("drop")
+        edge += self.duplicate
+        if r < edge:
+            return FaultDecision("duplicate")
+        edge += self.delay
+        if r < edge:
+            lo, hi = self.delay_range
+            return FaultDecision("delay", delay=rng.uniform(lo, hi))
+        edge += self.reorder
+        if r < edge:
+            return FaultDecision("reorder")
+        return FaultDecision("deliver")
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse the CLI's ``--faults SEED[,drop[,dup[,delay[,reorder]]]]``
+        (e.g. ``--faults 7,0.05,0.1``). Omitted probabilities are 0."""
+        parts = [p.strip() for p in str(spec).split(",")]
+        try:
+            seed = int(parts[0])
+            probs = [float(p) for p in parts[1:5]]
+        except (ValueError, IndexError) as e:
+            raise ValueError(
+                f"bad fault spec {spec!r}; want SEED[,drop[,dup[,delay[,reorder]]]]"
+            ) from e
+        probs += [0.0] * (4 - len(probs))
+        return cls(
+            seed=seed,
+            drop=probs[0],
+            duplicate=probs[1],
+            delay=probs[2],
+            reorder=probs[3],
+        )
+
+
+class FaultInjector:
+    """Applies a `FaultPlan` to a deployment's outgoing datagrams."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._counters: Dict[Tuple[int, int], int] = {}
+        self._held: Dict[Tuple[int, int], List[tuple]] = {}
+        self._heap: List[tuple] = []  # (due, tick, fire)
+        self._tick = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- engine hook ---------------------------------------------------------
+
+    def transmit(
+        self,
+        src: int,
+        dst: int,
+        payload: bytes,
+        send: Callable[[bytes], None],
+        recorder=None,
+        actor_index: Optional[int] = None,
+    ) -> None:
+        """Route one outgoing datagram through the plan. `send` performs
+        the actual wire send of a payload (engine-specific closure)."""
+        link = (int(src), int(dst))
+        with self._lock:
+            if self._closed:
+                return
+            n = self._counters.get(link, 0)
+            self._counters[link] = n + 1
+        decision = self.plan.decide(link[0], link[1], n)
+        if (
+            decision.kind != "deliver"
+            and recorder is not None
+            and actor_index is not None
+        ):
+            recorder.record_fault(
+                actor_index,
+                decision.kind,
+                dst,
+                n,
+                delay=decision.delay if decision.kind == "delay" else None,
+            )
+        if decision.kind == "reorder":
+            with self._cond:
+                if self._closed:
+                    _safe_send(send, payload)
+                    return
+                self._held.setdefault(link, []).append((send, payload))
+                self._push_locked(
+                    time.monotonic() + _REORDER_FLUSH_SECS,
+                    lambda: self._flush_held(link),
+                )
+            self._ensure_thread()
+            return
+        held = self._pop_held(link)
+        if decision.kind == "drop":
+            pass
+        elif decision.kind == "duplicate":
+            _safe_send(send, payload)
+            _safe_send(send, payload)
+        elif decision.kind == "delay":
+            with self._cond:
+                if self._closed:
+                    _safe_send(send, payload)
+                else:
+                    self._push_locked(
+                        time.monotonic() + decision.delay,
+                        lambda: _safe_send(send, payload),
+                    )
+            self._ensure_thread()
+        else:
+            _safe_send(send, payload)
+        # A held (reordered) datagram goes out AFTER its link's successor.
+        for s, p in held:
+            _safe_send(s, p)
+
+    def close(self) -> None:
+        """Flush everything still pending and stop the scheduler. Engines
+        call this at shutdown before closing the recorder."""
+        with self._cond:
+            self._closed = True
+            heap, self._heap = self._heap, []
+            held, self._held = self._held, {}
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=1.0)
+        for entries in held.values():
+            for s, p in entries:
+                _safe_send(s, p)
+        for _due, _tick, fire in sorted(heap):
+            try:
+                fire()
+            except Exception:
+                pass
+
+    # -- internals -----------------------------------------------------------
+
+    def _pop_held(self, link) -> List[tuple]:
+        with self._lock:
+            return self._held.pop(link, [])
+
+    def _flush_held(self, link) -> None:
+        for s, p in self._pop_held(link):
+            _safe_send(s, p)
+
+    def _push_locked(self, due: float, fire: Callable[[], None]) -> None:
+        heapq.heappush(self._heap, (due, self._tick, fire))
+        self._tick += 1
+        self._cond.notify_all()
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None and not self._closed:
+                self._thread = threading.Thread(
+                    target=self._scheduler, name="fault-injector", daemon=True
+                )
+                self._thread.start()
+
+    def _scheduler(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                if not self._heap:
+                    self._cond.wait(0.5)
+                    continue
+                due = self._heap[0][0]
+                now = time.monotonic()
+                if due > now:
+                    self._cond.wait(min(due - now, 0.5))
+                    continue
+                _due, _tick, fire = heapq.heappop(self._heap)
+            try:
+                fire()
+            except Exception:
+                pass
+
+
+def _safe_send(send: Callable[[bytes], None], payload: bytes) -> None:
+    try:
+        send(payload)
+    except Exception:
+        pass  # sockets may already be closing at shutdown
+
+
+def as_injector(faults) -> Optional[FaultInjector]:
+    """Normalize `spawn`'s ``faults=`` argument: None, a FaultPlan, a
+    spec string, or an already-built FaultInjector."""
+    if faults is None or isinstance(faults, FaultInjector):
+        return faults
+    if isinstance(faults, FaultPlan):
+        return FaultInjector(faults)
+    if isinstance(faults, str):
+        return FaultInjector(FaultPlan.from_spec(faults))
+    raise TypeError(f"faults must be a FaultPlan, spec string, or FaultInjector; got {faults!r}")

@@ -1,0 +1,95 @@
+"""Operation histories from recorded traces -> semantics/ testers.
+
+A recorded trace sees operations from the *client's* perspective: an
+operation is invoked when the client actor first puts its request on the
+wire (a ``send`` event) and returns when the matching response reaches it
+(a ``deliver`` event). That framing is what makes histories valid under
+injected faults and retries:
+
+  - a *retransmission* of an in-flight request is not a second invoke
+    (the tester would poison the history on a double in-flight op);
+  - a *duplicated* or *stale* response is not a second return (only the
+    response matching the currently in-flight request id counts).
+
+`extract_history` is the generic driver; `register_history` instantiates
+it for the Put/Get register protocol (actor/register.py clients over
+any server — ABD, single-copy, ...) against the `semantics/` `Register`
+sequential spec, yielding the same verdict machinery model checking uses
+(`LinearizabilityTester.serialized_history()`), now for a real run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..semantics.linearizability import LinearizabilityTester
+from ..semantics.register import READ, WRITE_OK, ReadOk, Register, Write
+
+# (request_id, operation) or None — "does this sent/delivered payload
+# invoke/return a client operation?"
+Matcher = Callable[[int, Any], Optional[Tuple[Any, Any]]]
+
+
+def extract_history(
+    events: List[dict],
+    tester,
+    invoke_of: Matcher,
+    return_of: Matcher,
+):
+    """Feed a trace's client operations through a semantics/ tester.
+
+    `invoke_of(actor, msg_jsonable)` maps a ``send`` payload to
+    ``(request_id, op)`` when it invokes an operation; `return_of` maps a
+    ``deliver`` payload to ``(request_id, ret)`` when it completes one.
+    Thread id is the client actor's index. Returns the tester.
+    """
+    in_flight: Dict[int, Any] = {}  # actor index -> pending request id
+    for ev in events:
+        actor = ev.get("actor")
+        if ev.get("kind") == "send":
+            hit = invoke_of(actor, ev.get("msg"))
+            if hit is None:
+                continue
+            rid, op = hit
+            if actor in in_flight:
+                continue  # retransmission of the in-flight op
+            in_flight[actor] = rid
+            tester.on_invoke(actor, op)
+        elif ev.get("kind") == "deliver":
+            hit = return_of(actor, ev.get("msg"))
+            if hit is None:
+                continue
+            rid, ret = hit
+            if in_flight.get(actor) != rid:
+                continue  # duplicate or stale response
+            del in_flight[actor]
+            tester.on_return(actor, ret)
+    return tester
+
+
+def register_history(
+    events: List[dict], tester=None
+) -> "LinearizabilityTester":
+    """History extraction for the Put/Get register protocol: client
+    ``Put``/``Get`` sends invoke ``Write``/``Read``; ``PutOk``/``GetOk``
+    deliveries return ``WriteOk``/``ReadOk``. Defaults to a fresh
+    `LinearizabilityTester(Register(None))`; pass a
+    `SequentialConsistencyTester` for the weaker verdict."""
+    if tester is None:
+        tester = LinearizabilityTester(Register(None))
+
+    def invoke_of(actor, msg):
+        if isinstance(msg, list) and len(msg) == 3 and msg[0] == "Put":
+            return (msg[1], Write(msg[2]))
+        if isinstance(msg, list) and len(msg) == 2 and msg[0] == "Get":
+            return (msg[1], READ)
+        return None
+
+    def return_of(actor, msg):
+        if isinstance(msg, list) and len(msg) == 2 and msg[0] == "PutOk":
+            return (msg[1], WRITE_OK)
+        if isinstance(msg, list) and len(msg) == 3 and msg[0] == "GetOk":
+            return (msg[1], ReadOk(msg[2]))
+        return None
+
+    return extract_history(events, tester, invoke_of, return_of)

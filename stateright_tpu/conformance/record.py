@@ -1,0 +1,186 @@
+"""TraceRecorder: the `spawn(..., record=path)` hook, engine-agnostic.
+
+Both spawn engines call the same three methods:
+
+  `attach(actors, engine)`      once, before any handler runs — learns the
+                                deployment roster, builds the id->index
+                                map, writes the ``meta`` line
+  `record_handler(...)`         after every handler (on_start / on_msg /
+                                on_timeout / on_random), with the
+                                post-handler state and the handler's `Out`
+  `record_fault(...)`           from the fault injector, at decision time
+
+Writing is the obs/trace.py discipline: thread-safe, one flushed JSONL
+line per event, writes after `close()` silently dropped. A handler event
+and its command children are written under one lock acquisition, so they
+are adjacent in the file and the trace is causally ordered: an actor's
+``send`` line precedes the wire datagram, which precedes the receiver's
+``deliver`` line.
+
+Sequence numbers are per-actor and monotonic from 0; command events
+consume sequence numbers too and name their parent via ``cause``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .events import command_views, jsonable
+
+
+class TraceRecorder:
+    """Records one deployment's events as JSONL (see conformance/README.md)."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "w", encoding="utf-8")
+        self._seqs: List[int] = []
+        self._id_map: Dict[int, int] = {}
+        self._attached = False
+
+    # -- engine hooks --------------------------------------------------------
+
+    def attach(self, actors, engine: str) -> None:
+        """Register the deployment roster: `actors` is the spawn-resolved
+        list of (Id, Actor) pairs, in model-index order."""
+        roster = []
+        for index, (id, actor) in enumerate(actors):
+            self._id_map[int(id)] = index
+            ip = int(id) >> 16
+            addr = ".".join(str((ip >> s) & 0xFF for s in (24, 16, 8, 0)))
+            roster.append(
+                {
+                    "index": index,
+                    "id": int(id),
+                    "addr": f"{addr}:{int(id) & 0xFFFF}",
+                    "actor": type(actor).__name__,
+                }
+            )
+        self._seqs = [0] * len(roster)
+        self._attached = True
+        self._write(
+            {
+                "kind": "meta",
+                "v": 1,
+                "engine": engine,
+                "ts": time.time(),
+                "actors": roster,
+            }
+        )
+
+    def record_handler(
+        self,
+        index: int,
+        kind: str,
+        state: Any,
+        out,
+        *,
+        src: Optional[int] = None,
+        msg: Any = None,
+        timer: Any = None,
+        value: Any = None,
+    ) -> None:
+        """One handler execution: `kind` is init/deliver/timeout/random,
+        `state` the post-handler actor state, `out` the handler's Out."""
+        now = time.time()
+        main: Dict[str, Any] = {
+            "kind": kind,
+            "actor": index,
+            "ts": now,
+            "state": jsonable(state, self._id_map),
+        }
+        if kind == "deliver":
+            main["src"] = self._map_id(src)
+            main["msg"] = jsonable(msg, self._id_map)
+        elif kind == "timeout":
+            main["timer"] = jsonable(timer, self._id_map)
+        elif kind == "random":
+            main["value"] = jsonable(value, self._id_map)
+        children = command_views(out.commands, self._id_map) if out else []
+        with self._lock:
+            if self._f.closed:
+                return
+            seq = self._next_seq(index)
+            main["seq"] = seq
+            self._write_locked(main)
+            for view in children:
+                child: Dict[str, Any] = {
+                    "kind": view[0],
+                    "actor": index,
+                    "seq": self._next_seq(index),
+                    "cause": seq,
+                    "ts": now,
+                }
+                if view[0] == "send":
+                    child["dst"] = view[1]
+                    child["msg"] = view[2]
+                elif view[0] in ("timer_set", "timer_cancel"):
+                    child["timer"] = view[1]
+                elif view[0] == "choose":
+                    child["key"] = view[1]
+                    child["choices"] = view[2]
+                self._write_locked(child)
+            self._f.flush()
+
+    def record_fault(
+        self,
+        index: int,
+        fault: str,
+        dst: int,
+        link_seq: int,
+        delay: Optional[float] = None,
+    ) -> None:
+        """One fault-injector decision on the `index` actor's outgoing link
+        to `dst` (the link's `link_seq`-th datagram)."""
+        record: Dict[str, Any] = {
+            "kind": "fault",
+            "actor": index,
+            "fault": fault,
+            "dst": self._map_id(dst),
+            "link_seq": int(link_seq),
+            "ts": time.time(),
+        }
+        if delay is not None:
+            record["delay"] = round(float(delay), 6)
+        self._write(record)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    # -- internals -----------------------------------------------------------
+
+    def _map_id(self, raw) -> int:
+        iv = int(raw)
+        return self._id_map.get(iv, iv)
+
+    def _next_seq(self, index: int) -> int:
+        while index >= len(self._seqs):  # defensive vs. late attach
+            self._seqs.append(0)
+        seq = self._seqs[index]
+        self._seqs[index] = seq + 1
+        return seq
+
+    def _write(self, record: dict) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._write_locked(record)
+            self._f.flush()
+
+    def _write_locked(self, record: dict) -> None:
+        self._f.write(json.dumps(record) + "\n")
+
+
+def as_recorder(record) -> Optional[TraceRecorder]:
+    """Normalize `spawn`'s ``record=`` argument: None, a path, or an
+    already-built TraceRecorder."""
+    if record is None or isinstance(record, TraceRecorder):
+        return record
+    return TraceRecorder(record)

@@ -134,6 +134,34 @@ def _coverage_view(checker: Checker) -> Dict:
     }
 
 
+def _trace_view(trace_path: Optional[str], query: str = "") -> Dict:
+    """GET /trace (alias /.trace): a recorded conformance trace
+    (conformance/record.py JSONL), served for the dashboard when the
+    Explorer was started with one (`serve(..., trace=path)` / the CLI's
+    ``explore --trace``). ``?limit=N`` caps the event list (default 2000)."""
+    if trace_path is None:
+        raise KeyError("no recorded trace attached (start with --trace PATH)")
+    from ..conformance import TraceError, load_trace
+
+    try:
+        meta, events = load_trace(trace_path)
+    except TraceError as e:
+        raise KeyError(str(e))
+    limit = 2000
+    for part in query.split("&"):
+        if part.startswith("limit="):
+            try:
+                limit = max(0, int(part[len("limit"):].lstrip("=")))
+            except ValueError:
+                pass
+    return {
+        "path": trace_path,
+        "meta": meta,
+        "count": len(events),
+        "events": events[:limit],
+    }
+
+
 def explain_view(checker: Checker, fingerprints_path: str) -> Dict:
     """Handler for GET /.explain/... (testable without a socket):
     counterexample forensics for the fingerprint path — the per-step
@@ -253,8 +281,9 @@ def states_views(checker: Checker, fingerprints_path: str) -> List[Dict]:
 class ExplorerServer:
     """A running Explorer; `serve()` constructs it."""
 
-    def __init__(self, builder: CheckerBuilder, address: str):
+    def __init__(self, builder: CheckerBuilder, address: str, trace: Optional[str] = None):
         self.snapshot = _Snapshot()
+        self.trace_path = trace  # recorded conformance trace to serve, if any
         builder.visitor(self.snapshot.visit)
         self.checker = builder.spawn_on_demand()
         self.model = self.checker.model()
@@ -298,6 +327,11 @@ class ExplorerServer:
                         self._send_json(_metrics_view(explorer.checker))
                 elif path in ("/coverage", "/.coverage"):
                     self._send_json(_coverage_view(explorer.checker))
+                elif path in ("/trace", "/.trace"):
+                    try:
+                        self._send_json(_trace_view(explorer.trace_path, query))
+                    except KeyError as e:
+                        self._send(404, str(e).encode(), "text/plain")
                 elif path.startswith("/.explain"):
                     try:
                         self._send_json(
@@ -368,13 +402,15 @@ class ExplorerServer:
         self.httpd.server_close()
 
 
-def serve(builder: CheckerBuilder, address: str, block: bool = True):
+def serve(builder: CheckerBuilder, address: str, block: bool = True,
+          trace: Optional[str] = None):
     """Start the Explorer. Reference: serve() (explorer.rs:79-99).
 
     With `block=False` the server runs on daemon threads and the handle is
-    returned (a testability capability the reference lacks).
+    returned (a testability capability the reference lacks). `trace`
+    attaches a recorded conformance trace, served at ``GET /trace``.
     """
-    server = ExplorerServer(builder, address)
+    server = ExplorerServer(builder, address, trace=trace)
     if block:
         server.serve_forever()
         return server.checker

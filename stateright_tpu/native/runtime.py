@@ -121,12 +121,15 @@ class _ActorShim:
 class NativeSpawnHandle:
     """Controls a running native deployment; mirrors spawn.SpawnHandle."""
 
-    def __init__(self, lib, handle: int, shims: List[_ActorShim], cb_ref):
+    def __init__(self, lib, handle: int, shims: List[_ActorShim], cb_ref,
+                 recorder=None, injector=None):
         self._lib = lib
         self._handle = handle
         self._shims = shims
         self._cb_ref = cb_ref  # keep the ctypes callback alive
         self._stopped = threading.Event()
+        self._recorder = recorder
+        self._injector = injector
 
     def state(self, id) -> Any:
         for shim in self._shims:
@@ -138,6 +141,12 @@ class NativeSpawnHandle:
         if not self._stopped.is_set():
             self._stopped.set()
             self._lib.srn_stop(self._handle)
+            # srn_send no-ops after srn_stop, so flushing the injector's
+            # delayed/held datagrams here is safe; seal the trace last.
+            if self._injector is not None:
+                self._injector.close()
+            if self._recorder is not None:
+                self._recorder.close()
 
 
 def spawn(
@@ -145,12 +154,21 @@ def spawn(
     deserialize: Callable[[bytes], Any],
     actors: List[Tuple[Id, Actor]],
     background: bool = False,
+    recorder=None,
+    injector=None,
 ) -> NativeSpawnHandle:
-    """Run the actor system on the native core. Reference: spawn.rs:64-154."""
+    """Run the actor system on the native core. Reference: spawn.rs:64-154.
+
+    `recorder`/`injector` are pre-normalized conformance hooks (see
+    `actor.spawn.spawn`'s ``record=``/``faults=``): same TraceEvent
+    stream and fault schedule as the Python engine.
+    """
     lib = _load()
     assert lib is not None, "native core not available"
 
     shims = [_ActorShim(i, id, actor) for i, (id, actor) in enumerate(actors)]
+    if recorder is not None:
+        recorder.attach(actors, engine="native")
     handle_box: List[int] = []
     # Native threads can deliver on_start before srn_start returns on this
     # thread; events hold until the handle is published (Event.wait releases
@@ -169,11 +187,18 @@ def spawn(
                     )
                     continue
                 ip, port = addr_from_id(Id(cmd.dst))
-                buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-                lib.srn_send(
-                    handle_box[0], shim.index, _ip_to_u32(ip), port, buf,
-                    len(payload),
-                )
+
+                def wire_send(data, _ip=_ip_to_u32(ip), _port=port, _index=shim.index):
+                    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+                    lib.srn_send(handle_box[0], _index, _ip, _port, buf, len(data))
+
+                if injector is not None:
+                    injector.transmit(
+                        int(shim.id), int(cmd.dst), payload, wire_send,
+                        recorder=recorder, actor_index=shim.index,
+                    )
+                else:
+                    wire_send(payload)
             elif isinstance(cmd, SetTimer):
                 lo, hi = cmd.duration
                 delay = _random.uniform(lo, hi) if lo < hi else lo
@@ -201,6 +226,8 @@ def spawn(
         try:
             if kind == 0:  # start
                 shim.state = shim.actor.on_start(shim.id, out)
+                if recorder is not None:
+                    recorder.record_handler(shim.index, "init", shim.state, out)
             elif kind == 1:  # datagram
                 payload = bytes(
                     ctypes.cast(
@@ -220,6 +247,11 @@ def spawn(
                 )
                 if returned is not None:
                     shim.state = returned
+                if recorder is not None:
+                    recorder.record_handler(
+                        shim.index, "deliver", shim.state, out,
+                        src=int(src), msg=msg,
+                    )
             else:  # deadline
                 obj = shim.obj_of.get(int(key))
                 if obj is None:
@@ -235,6 +267,17 @@ def spawn(
                     )
                 if returned is not None:
                     shim.state = returned
+                if recorder is not None:
+                    if k == "t":
+                        recorder.record_handler(
+                            shim.index, "timeout", shim.state, out,
+                            timer=payload_obj,
+                        )
+                    else:
+                        recorder.record_handler(
+                            shim.index, "random", shim.state, out,
+                            value=payload_obj,
+                        )
             dispatch(shim, out)
         except Exception:
             log.exception("actor %s: unhandled error in event handler", shim.id)
@@ -252,7 +295,7 @@ def spawn(
         raise OSError(f"native spawn failed to bind actor {-1 - handle}")
     handle_box.append(handle)
     handle_ready.set()
-    h = NativeSpawnHandle(lib, handle, shims, cb)
+    h = NativeSpawnHandle(lib, handle, shims, cb, recorder=recorder, injector=injector)
     if not background:
         try:
             while True:

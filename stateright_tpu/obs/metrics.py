@@ -28,6 +28,14 @@ Counters (`inc`) — monotonic totals:
                          ``lint_STR303``) when the run was linted — strict
                          mode or an explicit `CheckerBuilder.lint()`
                          (catalog: analysis/README.md)
+  ``conformance_events``  trace events consumed by `conformance.check_trace`
+  ``conformance_steps``   trace events explained as model transitions
+  ``conformance_stutters``  events the model prunes as no-ops (duplicate
+                         redeliveries, pure timer re-arms) — expected under
+                         fault injection, not divergences
+  ``conformance_faults``  injected-fault events recorded in the trace
+  ``conformance_divergences``  trace events the model could NOT explain
+                         (catalog: conformance/README.md)
   =====================  =====================================================
 
 Gauges (`set_gauge`) — last-observed values:
@@ -46,6 +54,8 @@ Gauges (`set_gauge`) — last-observed values:
   ``n_shards`` / ``quota``   mesh engine shard count / exchange quota
   ``lint_errors`` / ``lint_warnings``  speclint finding counts by severity
                            (linted runs only)
+  ``conformance_history_ops``  operations in the client history extracted
+                           from a checked trace (conformance/history.py)
   ``coverage_actions_fired``  distinct actions observed firing so far
                            (obs/coverage.py; the per-action breakdown is
                            `Checker.coverage()`, not a metric)
