@@ -924,7 +924,89 @@ def main() -> None:
             "workers_secs": round(par_secs, 2),
         }
 
+    def _sec_service():
+        # --- checking-as-a-service: 32 concurrent small checks over REST ------
+        # The run server's reason to exist (ROADMAP item 3): many small
+        # same-shape checks packed as vmapped lanes of ONE fused era,
+        # sharing one compiled executable via the ExecutableCache, vs the
+        # status-quo serial per-request device spawns where every fresh
+        # model instance re-traces the loop (id(tm)-keyed jit caches).
+        # Acceptance: >= 5x aggregate checks/sec, exactly 1 cache miss,
+        # every result on the 13-unique increment golden.
+        import json as _json
+        import urllib.request
+
+        from stateright_tpu.serve import RunService, ServeServer
+
+        n_checks = 32
+        # Serial baseline first: per-request spawns over FRESH instances
+        # (a service without the intern pool sees a new id(tm) each time).
+        serial_n = 8
+        solo_opts = dict(
+            chunk_size=64, queue_capacity=1 << 10, table_capacity=1 << 12
+        )
+        t0 = time.perf_counter()
+        for _ in range(serial_n):
+            c = (
+                TensorModelAdapter(IncrementTensor(2))
+                .checker()
+                .multiplex_lane()  # silence the (correct) small-workload hint
+                .spawn_tpu_bfs(**solo_opts)
+                .join()
+            )
+            assert c.unique_state_count() == 13, c.unique_state_count()
+        serial_secs = time.perf_counter() - t0
+        serial_rate = serial_n / serial_secs
+
+        svc = RunService(workers=1, lanes=n_checks, lint_samples=32)
+        server = ServeServer(svc, "127.0.0.1:0").serve_in_background()
+        base = server.url.rstrip("/")
+
+        def req(method, path, body=None):
+            data = _json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(base + path, data=data, method=method)
+            with urllib.request.urlopen(r) as resp:
+                return _json.loads(resp.read())
+
+        try:
+            req("POST", "/scheduler/pause")
+            ids = [
+                req("POST", "/submit", {"spec": "increment:2"})["job_id"]
+                for _ in range(n_checks)
+            ]
+            t0 = time.perf_counter()
+            req("POST", "/scheduler/resume")
+            while True:
+                views = req("GET", "/jobs")["jobs"]
+                if all(v["status"] not in ("queued", "running") for v in views):
+                    break
+                time.sleep(0.05)
+            mux_secs = time.perf_counter() - t0
+            for job_id in ids:
+                result = req("GET", f"/jobs/{job_id}/result")["result"]
+                assert result["unique_state_count"] == 13, result
+            cache = req("GET", "/stats")["cache"]
+            # One shape, one executable: the whole batch compiled ONCE.
+            assert cache["misses"] == 1, cache
+        finally:
+            server.shutdown()
+        mux_rate = n_checks / mux_secs
+        speedup = mux_rate / serial_rate
+        detail["service"] = {
+            "concurrent_checks": n_checks,
+            "multiplexed_checks_per_sec": round(mux_rate, 2),
+            "serial_per_request_checks_per_sec": round(serial_rate, 2),
+            "speedup": round(speedup, 1),
+            "cache": cache,
+            "cache_hit_rate": round(
+                cache["hits"] / max(1, cache["hits"] + cache["misses"]), 3
+            ),
+            "golden_match": True,
+        }
+        assert speedup >= 5.0, detail["service"]
+
     section("single_copy4", _sec_single_copy4)
+    section("service", _sec_service)
     section("pbfs_paxos3", _sec_pbfs_paxos3)
     section("tpc10_symmetry", _sec_tpc10_symmetry)
     section("paxos3", _sec_paxos3)

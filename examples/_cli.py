@@ -126,6 +126,15 @@ def example_main(
         if dropped:
             print(f"{name} spawn ignores flags: {', '.join('--' + f for f in dropped)}")
         spawn_info(**supported)
+    elif subcommand == "serve":
+        # Start the multi-tenant run server (stateright_tpu.serve): every
+        # example exposes the same service; submissions name models by
+        # bundled spec ("2pc:3") rather than this example's build_model.
+        from stateright_tpu.serve import serve as serve_run_service
+
+        address = arg(0, "localhost:3001")
+        print(f"Run service (submit specs like 2pc:3) on {address}.")
+        serve_run_service(address)
     elif subcommand == "conform":
         if conform_info is None:
             print(f"{name} does not support the conform subcommand.")
@@ -151,6 +160,6 @@ def example_main(
     else:
         print(
             f"Usage: {sys.argv[0]} "
-            "[check|check-dfs|check-simulation|lint|explore|spawn|conform]"
+            "[check|check-dfs|check-simulation|lint|explore|serve|spawn|conform]"
         )
         raise SystemExit(1)

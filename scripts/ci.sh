@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Five stages, fail-fast:
+# Six stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -14,7 +14,11 @@
 #      UDP under seeded drop/duplicate/delay faults, records a trace, and
 #      the trace must conform against the actor model with ZERO
 #      divergences and yield a nonzero linearizable client history,
-#   5. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   5. a serve smoke: the run server admits a 2pc-3 check plus a batch of
+#      8 small increment checks over REST, multiplexes the batch into one
+#      fused executable, matches the golden state counts, and reports an
+#      executable-cache hit on resubmission,
+#   6. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -72,6 +76,70 @@ assert tester.serialized_history() is not None and len(tester) > 0, (
     "expected a nonzero linearizable client history"
 )
 print(f"conformance smoke OK: {report.steps} steps, {len(tester)} history ops")
+PY
+
+echo "== serve smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import time
+import urllib.request
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+from stateright_tpu.serve import RunService, ServeServer
+
+# Host oracle for the 2pc-3 golden (288 uniques) before anything serves.
+oracle = TensorModelAdapter(TwoPhaseTensor(3)).checker().spawn_bfs().join()
+assert oracle.unique_state_count() == 288, oracle.unique_state_count()
+
+service = RunService(workers=1, lanes=8, lint_samples=32)
+server = ServeServer(service, "127.0.0.1:0").serve_in_background()
+base = server.url.rstrip("/")
+
+
+def req(method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method)
+    with urllib.request.urlopen(r) as resp:
+        return json.loads(resp.read())
+
+
+req("POST", "/scheduler/pause")
+inc_ids = [
+    req("POST", "/submit", {"spec": "increment:2"})["job_id"] for _ in range(8)
+]
+tpc_id = req("POST", "/submit", {"spec": "2pc:3"})["job_id"]
+req("POST", "/scheduler/resume")
+
+deadline = time.time() + 600
+while time.time() < deadline:
+    views = req("GET", "/jobs")["jobs"]
+    if all(v["status"] not in ("queued", "running") for v in views):
+        break
+    time.sleep(0.2)
+for v in req("GET", "/jobs")["jobs"]:
+    assert v["status"] == "done", v
+
+for job_id in inc_ids:
+    result = req("GET", f"/jobs/{job_id}/result")["result"]
+    assert result["unique_state_count"] == 13, result
+    assert result["engine"] == "multiplex", result
+tpc = req("GET", f"/jobs/{tpc_id}/result")["result"]
+assert tpc["unique_state_count"] == oracle.unique_state_count(), tpc
+
+# Same-shape resubmission must hit the executable cache.
+before = req("GET", "/stats")["cache"]
+job_id = req("POST", "/submit", {"spec": "increment:2"})["job_id"]
+while req("GET", f"/jobs/{job_id}")["status"] in ("queued", "running"):
+    time.sleep(0.2)
+after = req("GET", "/stats")["cache"]
+assert after["hits"] == before["hits"] + 1, (before, after)
+assert after["misses"] == before["misses"], (before, after)
+server.shutdown()
+print(
+    f"serve smoke OK: 8 multiplexed + 2pc-3 golden-matched, "
+    f"cache {after['hits']} hits / {after['misses']} misses"
+)
 PY
 
 echo "== tier-1 tests =="

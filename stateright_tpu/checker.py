@@ -56,6 +56,7 @@ class CheckerBuilder:
         self.strict_: bool = False
         self.strict_samples_: int = 128
         self.lint_report_: Optional[Any] = None
+        self.multiplex_lane_: bool = False
 
     # -- options ------------------------------------------------------------
 
@@ -130,6 +131,16 @@ class CheckerBuilder:
         histograms into their era loops, so disabling buys back only a
         few percent of throughput (bench.py records both numbers)."""
         self.coverage_ = enable
+        return self
+
+    def multiplex_lane(self, enable: bool = True) -> "CheckerBuilder":
+        """Mark this run as one lane of a multiplexed batch
+        (engines/multiplex.py / the serve/ run service). Lanes share one
+        compiled executable and one fused device era with their whole
+        batch, so the device engines' small-workload hint — which warns
+        about exactly the per-run overheads multiplexing amortizes away —
+        is suppressed for them."""
+        self.multiplex_lane_ = enable
         return self
 
     def profile(self, log_dir: str) -> "CheckerBuilder":

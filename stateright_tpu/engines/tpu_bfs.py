@@ -35,7 +35,6 @@ drive the same TLC-style path reconstruction (bfs.rs:380-409).
 
 from __future__ import annotations
 
-import functools
 import os
 import sys
 import time
@@ -127,7 +126,7 @@ def _vcap(A: int, chunk: int) -> int:
 
 
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False,
-                cov: bool = True):
+                cov: bool = True, raw: bool = False):
     """Compile the BFS device "era" loop.
 
     Returns a jitted function
@@ -142,8 +141,13 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     needs no host intervention costs a single ~100ms tunnel round-trip
     regardless of depth — the decisive constant on this remote-attached
     platform (see the measured notes below).
+
+    With ``raw=True`` the UN-jitted loop function is returned instead (no
+    donation): that is what the multiplexed lane engine
+    (engines/multiplex.py) wraps in `jax.vmap` — an inner jit would defeat
+    batching and donation is illegal on a vmapped operand it does not own.
     """
-    key = (id(tm), chunk, qcap, len(props), canon, cov)
+    key = (id(tm), chunk, qcap, len(props), canon, cov, raw)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -177,9 +181,6 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     # enough to be cache-hot.
     dedup_cap = 1 << max(1, (4 * vcap - 1).bit_length())
 
-    # Table and ring donate on device backends only — donation under the
-    # CPU persistent compilation cache miscompiles (compat docstring).
-    @functools.partial(jax.jit, donate_argnums=donate_argnums_safe(0, 1))
     def loop(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
         head0 = params[P_HEAD]
@@ -540,6 +541,11 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         params_out = jnp.concatenate(parts)
         return table, queue, rec_fp1, rec_fp2, params_out
 
+    if not raw:
+        # Table and ring donate on device backends only — donation under
+        # the CPU persistent compilation cache miscompiles (compat
+        # docstring).
+        loop = jax.jit(loop, donate_argnums=donate_argnums_safe(0, 1))
     _LOOP_CACHE[key] = (tm, loop)
     return loop
 
@@ -870,6 +876,7 @@ class TpuBfsChecker(HostEngineBase):
         checkpoint_path: Optional[str] = None,
         checkpoint_every: Optional[float] = None,
         resume_from: Optional[str] = None,
+        compiled=None,
     ):
         model = builder.model
         if isinstance(model, TensorModel):
@@ -879,6 +886,21 @@ class TpuBfsChecker(HostEngineBase):
                 "spawn_tpu_bfs requires a TensorModel (or its adapter); "
                 "rich host models must be encoded first — see stateright_tpu.tensor."
             )
+        if compiled is not None:
+            # Build/run split (engines/compiled.py): run against the
+            # compiled check's interned model instance so every id(tm)-keyed
+            # jit cache below hits — the request pays a dict lookup, not a
+            # trace + XLA compile.
+            from .compiled import model_signature
+
+            if model_signature(model.tm) != compiled.signature:
+                raise ValueError(
+                    "CompiledCheck signature mismatch: executable was built "
+                    f"for {compiled.signature!r}, builder model is "
+                    f"{model_signature(model.tm)!r}"
+                )
+            if model.tm is not compiled.tm:
+                model = TensorModelAdapter(compiled.tm)
         super().__init__(builder, model=model)
         if self._visitor is not None:
             raise ValueError("the TPU engine does not support visitors")
@@ -954,6 +976,13 @@ class TpuBfsChecker(HostEngineBase):
         # attribute the measured device_era time (obs/stageprof.py).
         self._stage_profile = bool(getattr(builder, "stage_profile_", False))
         self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
+        # Multiplexed-lane runs are the INTENDED path for sub-crossover
+        # state spaces (serve/README.md): a lane shares one compiled
+        # executable and one fused era with its whole batch, so the
+        # per-run dispatch/compile overheads the hint warns about do not
+        # apply — firing it there would steer users away from the right
+        # engine.
+        self._mux_lane = bool(getattr(builder, "multiplex_lane_", False))
         # Small-workload guard: with a state-count target under the
         # crossover, the host engine will beat this one — say so up front
         # (the run-end check below catches untargeted small runs).
@@ -1363,6 +1392,8 @@ class TpuBfsChecker(HostEngineBase):
         """One-line telemetry warning: below the crossover the host engine
         wins (the device engine's fixed dispatch/compile overheads dominate
         small state spaces — README "engine crossover")."""
+        if getattr(self, "_mux_lane", False):
+            return  # multiplexed lanes ARE the small-workload path
         if getattr(self, "_hinted_small", False):
             return  # once per run
         self._hinted_small = True

@@ -47,6 +47,38 @@ _UI_DIR = FsPath(__file__).parent / "ui"
 _SNAPSHOT_REFRESH_SECS = 4.0  # explorer.rs:90-93
 
 
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared JSON-over-HTTP plumbing for this package's services (the
+    Explorer here; the run service in serve/http.py). Subclasses implement
+    `do_GET`/`do_POST` on top of `_send_json` / `_read_json`."""
+
+    def log_message(self, fmt, *args):
+        pass  # quiet
+
+    def _send(self, code: int, body: bytes, content_type: str):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, payload, code=200):
+        self._send(code, json.dumps(payload).encode(), "application/json")
+
+    def _read_json(self):
+        """The request body parsed as JSON; {} when empty, None (after a
+        400 reply) when unparsable."""
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except ValueError:
+            self._send_json({"error": "request body is not valid JSON"}, 400)
+            return None
+
+
 class _Snapshot:
     """Records one visited path, rearmed periodically (explorer.rs:60-76)."""
 
@@ -296,20 +328,7 @@ class ExplorerServer:
 
         explorer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):
-                pass  # quiet
-
-            def _send(self, code: int, body: bytes, content_type: str):
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _send_json(self, payload, code=200):
-                self._send(code, json.dumps(payload).encode(), "application/json")
-
+        class Handler(JsonRequestHandler):
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if path == "/.status":

@@ -36,6 +36,24 @@ Counters (`inc`) — monotonic totals:
   ``conformance_faults``  injected-fault events recorded in the trace
   ``conformance_divergences``  trace events the model could NOT explain
                          (catalog: conformance/README.md)
+  ``serve_requests``     run-service submissions received (serve/service.py)
+  ``serve_rejected_lint``  submissions rejected by the speclint admission
+                         gate (422; STRxxx codes in the response body)
+  ``serve_rejected_quota``  submissions rejected by per-tenant quotas or
+                         rate limits (429)
+  ``serve_completed``    jobs finished with results available
+  ``serve_failed``       jobs that errored during execution
+  ``serve_cancelled``    jobs cancelled while queued
+  ``serve_exec_cache_hits``    executable-cache hits (a warm `CompiledCheck`
+                         served the run; engines/compiled.py)
+  ``serve_exec_cache_misses``  executable-cache misses (trace + lower paid)
+  ``serve_multiplexed_jobs``  jobs executed as lanes of a fused vmapped
+                         batch (engines/multiplex.py)
+  ``serve_batches``      multiplexed batch dispatches executed
+  ``serve_tenant_requests``  dict counter (`inc_labeled`): submissions per
+                         tenant id — rendered as a labeled
+                         ``{tenant="..."}`` series in the Prometheus
+                         exposition
   =====================  =====================================================
 
 Gauges (`set_gauge`) — last-observed values:
@@ -81,6 +99,8 @@ Gauges (`set_gauge`) — last-observed values:
                            isolated kernels)
   ``stage_profile_error``  repr of the exception if stage profiling failed
                            (profiling is best-effort and never fails a run)
+  ``serve_queue_depth``    run-service jobs currently queued (serve/)
+  ``serve_active_jobs``    run-service jobs currently executing
   =======================  ===================================================
 
 Phase timers (`phase(name)` context manager / `add_phase`) — cumulative
@@ -166,6 +186,18 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, default)
 
+    def inc_labeled(self, name: str, key: str, delta: int = 1) -> None:
+        """Increment one series of a dict-valued counter (e.g. per-tenant
+        request totals). The snapshot carries the whole dict under `name`;
+        `render_prometheus(..., labels={name: "tenant"})` turns it into a
+        labeled Prometheus family."""
+        with self._lock:
+            series = self._counters.get(name)
+            if not isinstance(series, dict):
+                series = {}
+                self._counters[name] = series
+            series[key] = series.get(key, 0) + int(delta)
+
     # -- gauges --------------------------------------------------------------
 
     def set_gauge(self, name: str, value: Any) -> None:
@@ -197,7 +229,10 @@ class MetricsRegistry:
         """Flat counters + gauges, plus nested ``phase_ms`` when any phase
         has been timed. This is what `Checker.telemetry()` returns."""
         with self._lock:
-            out: Dict[str, Any] = dict(self._counters)
+            out: Dict[str, Any] = {
+                k: dict(v) if isinstance(v, dict) else v
+                for k, v in self._counters.items()
+            }
             out.update(self._gauges)
             if self._phase_secs:
                 out["phase_ms"] = {
@@ -217,7 +252,11 @@ def _prom_name(name: str, prefix: str) -> str:
     return prefix + safe
 
 
-def render_prometheus(snapshot: Dict[str, Any], prefix: str = "stateright_") -> str:
+def render_prometheus(
+    snapshot: Dict[str, Any],
+    prefix: str = "stateright_",
+    labels: Dict[str, str] | None = None,
+) -> str:
     """Render a telemetry snapshot (flat counters/gauges + nested
     ``phase_ms``) in the Prometheus text exposition format (v0.0.4).
 
@@ -225,9 +264,14 @@ def render_prometheus(snapshot: Dict[str, Any], prefix: str = "stateright_") -> 
     timers flatten to ``<prefix>phase_ms{phase="<name>"}``. Snapshots
     merge counters and gauges into one namespace, so everything is
     emitted untyped; non-numeric values (the ``engine`` tag) become
-    labels on an info-style gauge. Serve it from the Explorer via
-    ``GET /metrics?format=prometheus`` (alias ``/metrics.prom``).
+    labels on an info-style gauge. ``labels`` maps the name of a
+    dict-valued metric (`MetricsRegistry.inc_labeled`) to the label key
+    its series render under, e.g. ``{"serve_tenant_requests": "tenant"}``
+    -> ``serve_tenant_requests{tenant="acme"} 3``. Serve it from the
+    Explorer via ``GET /metrics?format=prometheus`` (alias
+    ``/metrics.prom``).
     """
+    labels = labels or {}
     lines = []
     engine = snapshot.get("engine")
     if engine:
@@ -241,6 +285,18 @@ def render_prometheus(snapshot: Dict[str, Any], prefix: str = "stateright_") -> 
             lines.append(f"# TYPE {name} untyped")
             for phase in sorted(value):
                 lines.append(f'{name}{{phase="{phase}"}} {value[phase]}')
+            continue
+        if key in labels and isinstance(value, dict):
+            name = _prom_name(key, prefix)
+            label = labels[key]
+            lines.append(f"# TYPE {name} untyped")
+            for series in sorted(value):
+                v = value[series]
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, (int, float)):
+                    safe = str(series).replace("\\", "\\\\").replace('"', '\\"')
+                    lines.append(f'{name}{{{label}="{safe}"}} {v}')
             continue
         if isinstance(value, bool):
             value = int(value)

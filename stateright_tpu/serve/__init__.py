@@ -1,0 +1,12 @@
+"""Checking-as-a-service: the multi-tenant run server (ROADMAP item 3).
+
+`RunService` (service.py) is the queue/scheduler/quota core over the
+engine layer's build/run split (engines/compiled.py) and the vmapped
+lane-multiplexing engine (engines/multiplex.py); `ServeServer` (http.py)
+is its REST frontend. ``python -m stateright_tpu.serve`` starts one.
+"""
+
+from .http import ServeServer, serve
+from .service import Job, RunService
+
+__all__ = ["Job", "RunService", "ServeServer", "serve"]

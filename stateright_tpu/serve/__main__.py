@@ -1,0 +1,49 @@
+"""``python -m stateright_tpu.serve [HOST:PORT]`` — start the run server.
+
+Scheduler knobs ride flags; everything else is serve/README.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .http import serve
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m stateright_tpu.serve",
+        description="multi-tenant model-checking run server",
+    )
+    parser.add_argument(
+        "address", nargs="?", default="127.0.0.1:3001",
+        help="bind address (default 127.0.0.1:3001; port 0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--workers", type=int, default=2, help="scheduler worker threads"
+    )
+    parser.add_argument(
+        "--lanes", type=int, default=32,
+        help="multiplexed lane count per fused batch",
+    )
+    parser.add_argument(
+        "--max-active", type=int, default=256,
+        help="per-tenant active-job quota",
+    )
+    parser.add_argument(
+        "--per-minute", type=int, default=600,
+        help="per-tenant submissions-per-minute rate limit",
+    )
+    args = parser.parse_args(argv)
+    serve(
+        args.address,
+        workers=args.workers,
+        lanes=args.lanes,
+        quota_max_active=args.max_active,
+        quota_per_minute=args.per_minute,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

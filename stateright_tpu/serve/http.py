@@ -1,0 +1,179 @@
+"""REST surface of the run service, on the Explorer's HTTP stack.
+
+Routes (JSON in/out unless noted):
+
+  ``POST /submit``                admit a check: ``{"spec": "2pc:3",
+                                  "tenant": "...", "priority": 0,
+                                  "engine": "auto|multiplex|tpu_bfs|bfs",
+                                  "target_max_depth": N}`` ->
+                                  202 ``{"job_id", "status"}``; 400
+                                  malformed, 422 speclint STRxxx
+                                  diagnostics, 429 quota/rate limit
+  ``GET /jobs``                   all job views (``?tenant=`` filters)
+  ``GET /jobs/{id}``              one job's status view
+  ``GET /jobs/{id}/result``       the finished job's results (404
+                                  unknown, 409 while queued/running)
+  ``POST /jobs/{id}/cancel``      cancel a queued job (409 otherwise)
+  ``POST /scheduler/pause``       freeze the scheduler (deterministic
+  ``POST /scheduler/resume``      batching for tests/CI)
+  ``GET /stats``                  queue/cache/quota summary
+  ``GET /metrics``                service telemetry snapshot (JSON)
+  ``GET /metrics.prom``           Prometheus text exposition with the
+                                  per-tenant request series labeled
+  ``GET /healthz``                liveness
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+from ..explorer.server import JsonRequestHandler
+from ..obs.metrics import render_prometheus
+from .service import RunService
+
+__all__ = ["ServeServer", "serve"]
+
+
+class ServeServer:
+    """A running run-service HTTP frontend; `serve()` constructs it."""
+
+    def __init__(self, service: RunService, address: str = "127.0.0.1:3001"):
+        self.service = service
+        host, _, port = address.replace(
+            "localhost", "127.0.0.1"
+        ).partition(":")
+        self.address = (host or "127.0.0.1", int(port or 3001))
+
+        svc = service
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                parts = [p for p in path.split("/") if p]
+                if path == "/healthz":
+                    self._send_json({"ok": True})
+                elif path == "/stats":
+                    self._send_json(svc.stats())
+                elif path == "/metrics.prom" or (
+                    path == "/metrics" and "format=prometheus" in query
+                ):
+                    body = render_prometheus(
+                        svc.telemetry(),
+                        labels={"serve_tenant_requests": "tenant"},
+                    )
+                    self._send(
+                        200,
+                        body.encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/metrics":
+                    self._send_json(svc.telemetry())
+                elif path == "/jobs":
+                    tenant = None
+                    for part in query.split("&"):
+                        if part.startswith("tenant="):
+                            tenant = part[len("tenant="):]
+                    self._send_json({"jobs": svc.jobs(tenant)})
+                elif len(parts) == 2 and parts[0] == "jobs":
+                    job = svc.job(parts[1])
+                    if job is None:
+                        self._send_json({"error": f"no job {parts[1]!r}"}, 404)
+                    else:
+                        self._send_json(job.view())
+                elif (
+                    len(parts) == 3
+                    and parts[0] == "jobs"
+                    and parts[2] == "result"
+                ):
+                    job = svc.job(parts[1])
+                    if job is None:
+                        self._send_json({"error": f"no job {parts[1]!r}"}, 404)
+                    elif job.status in ("queued", "running"):
+                        self._send_json(
+                            {"error": f"job {parts[1]} is {job.status}"},
+                            409,
+                        )
+                    elif job.result is None:
+                        self._send_json(
+                            {"error": job.error or f"job {parts[1]} "
+                             f"finished {job.status} without results"},
+                            409,
+                        )
+                    else:
+                        self._send_json(
+                            {"job": job.view(), "result": job.result}
+                        )
+                else:
+                    self._send_json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                parts = [p for p in path.split("/") if p]
+                if path == "/submit":
+                    payload = self._read_json()
+                    if payload is None:
+                        return
+                    code, body = svc.submit(payload)
+                    self._send_json(body, code)
+                elif (
+                    len(parts) == 3
+                    and parts[0] == "jobs"
+                    and parts[2] == "cancel"
+                ):
+                    code, body = svc.cancel(parts[1])
+                    self._send_json(body, code)
+                elif path == "/scheduler/pause":
+                    svc.pause()
+                    self._send_json({"paused": True})
+                elif path == "/scheduler/resume":
+                    svc.resume()
+                    self._send_json({"paused": False})
+                else:
+                    self._send_json({"error": "not found"}, 404)
+
+        self.httpd = ThreadingHTTPServer(self.address, Handler)
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}/"
+
+    def serve_forever(self):
+        print(f"Run service ready. {self.url}")
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def serve_in_background(self) -> "ServeServer":
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.service.shutdown()
+
+
+def serve(
+    address: str = "127.0.0.1:3001",
+    service: Optional[RunService] = None,
+    block: bool = True,
+    **service_options,
+) -> ServeServer:
+    """Start the run service (``python -m stateright_tpu.serve`` / the
+    examples CLI ``serve`` subcommand). ``block=False`` runs on daemon
+    threads and returns the handle (port 0 binds an ephemeral port —
+    the tests' and CI smoke's path)."""
+    server = ServeServer(
+        service or RunService(**service_options), address
+    )
+    if block:
+        server.serve_forever()
+    else:
+        server.serve_in_background()
+    return server

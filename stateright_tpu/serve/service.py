@@ -1,0 +1,488 @@
+"""The run service: queue, scheduler, quotas, and the executable cache.
+
+`RunService` is the engine-facing half of checking-as-a-service (the
+HTTP surface is serve/http.py). A submission names a bundled model spec
+(analysis/__main__.py's registry — ``"2pc:3"``, ``"increment:2"``, or a
+``pkg.module:Factory:ARGS`` path) and rides this pipeline:
+
+  admission   speclint gates every submission (`CheckerBuilder.lint`):
+              error-severity STRxxx findings reject with 422 BEFORE
+              anything compiles — a broken spec must not spend device
+              time. Reports are cached per model signature.
+  quotas      per-tenant active-job caps and a rolling per-minute
+              submission rate limit reject with 429.
+  queue       a priority heap drained by worker threads; queued jobs
+              are cancellable; `pause()`/`resume()` freeze the
+              scheduler (tests and the CI smoke use this to force
+              deterministic batching).
+  execution   tensor models default to the multiplexed lane engine
+              (engines/multiplex.py): a worker popping a lane-eligible
+              job gathers every same-signature queued job into ONE
+              fused vmapped batch — thousands of small checks share
+              one compiled executable. Solo device runs and host-model
+              runs (``engine="tpu_bfs"`` / ``"bfs"``) are served too.
+              All device paths go through the `ExecutableCache`
+              (engines/compiled.py), so a same-shape resubmission
+              reuses the warm executable outright.
+  results     state counts, per-property discovery paths with
+              `Path.explain` forensics, telemetry, and coverage.
+
+Every stage exports `serve_*` metrics (obs/metrics.py catalog) with
+per-tenant request counts as a labeled Prometheus series.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engines.compiled import ExecutableCache, model_signature
+from ..obs.metrics import MetricsRegistry
+from ..tensor import TensorModel, TensorModelAdapter
+
+__all__ = ["Job", "RunService"]
+
+_RATE_WINDOW_SECS = 60.0
+
+
+class Job:
+    """One submitted check, from admission through results."""
+
+    __slots__ = (
+        "id", "tenant", "spec", "engine", "priority", "status",
+        "submitted_at", "started_at", "finished_at", "error", "result",
+        "signature", "model", "options",
+    )
+
+    def __init__(self, tenant: str, spec: str, engine: str, priority: int,
+                 model: Any, signature: Optional[str],
+                 options: Dict[str, Any]):
+        self.id = uuid.uuid4().hex[:12]
+        self.tenant = tenant
+        self.spec = spec
+        self.engine = engine
+        self.priority = priority
+        self.status = "queued"
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.error: Optional[str] = None
+        self.result: Optional[Dict[str, Any]] = None
+        self.signature = signature
+        self.model = model
+        self.options = options
+
+    def view(self) -> Dict[str, Any]:
+        out = {
+            "job_id": self.id,
+            "tenant": self.tenant,
+            "spec": self.spec,
+            "engine": self.engine,
+            "priority": self.priority,
+            "status": self.status,
+            "submitted_at": self.submitted_at,
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+def _resolve_spec(spec: str):
+    """analysis/__main__.py's model registry, with its CLI-style
+    SystemExit turned into a service-style ValueError."""
+    from ..analysis.__main__ import resolve_model
+
+    try:
+        return resolve_model(spec)
+    except SystemExit:
+        raise ValueError(f"unknown model spec {spec!r}")
+    except Exception as e:  # bad ARGS, import errors in dotted paths
+        raise ValueError(f"unable to construct model from {spec!r}: {e}")
+
+
+class RunService:
+    """Multi-tenant run queue + scheduler + executable cache."""
+
+    def __init__(
+        self,
+        *,
+        workers: int = 2,
+        lanes: int = 32,
+        lane_chunk: int = 256,
+        lane_queue_capacity: int = 1 << 13,
+        lane_table_capacity: int = 1 << 16,
+        solo_chunk: int = 4096,
+        solo_queue_capacity: int = 1 << 17,
+        solo_table_capacity: int = 1 << 19,
+        exec_cache_capacity: int = 8,
+        quota_max_active: int = 256,
+        quota_per_minute: int = 600,
+        lint_samples: int = 64,
+    ):
+        self.lanes = lanes
+        self.lane_options = {
+            "lanes": lanes,
+            "chunk": lane_chunk,
+            "queue_capacity": lane_queue_capacity,
+            "table_capacity": lane_table_capacity,
+        }
+        self.solo_options = {
+            "chunk_size": solo_chunk,
+            "queue_capacity": solo_queue_capacity,
+            "table_capacity": solo_table_capacity,
+        }
+        self.quota_max_active = quota_max_active
+        self.quota_per_minute = quota_per_minute
+        self.lint_samples = lint_samples
+
+        self.metrics = MetricsRegistry()
+        self.cache = ExecutableCache(capacity=exec_cache_capacity)
+        self._cv = threading.Condition()
+        self._heap: List[Tuple[int, int, Job]] = []
+        self._seq = itertools.count()
+        self._jobs: Dict[str, Job] = {}
+        self._tenant_submits: Dict[str, deque] = {}
+        self._lint_cache: Dict[str, Any] = {}
+        self._paused = False
+        self._stop = False
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(max(1, workers))
+        ]
+        for t in self._workers:
+            t.start()
+
+    # -- scheduler control ---------------------------------------------------
+
+    def pause(self) -> None:
+        """Freeze the scheduler: submissions queue but nothing executes.
+        Deterministic-batching hook for tests and the CI smoke."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._workers:
+            t.join(timeout=5)
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """Admit one submission. Returns ``(http_status, body)``:
+        202 queued, 400 malformed, 422 speclint rejection, 429 quota."""
+        self.metrics.inc("serve_requests")
+        spec = payload.get("spec") or payload.get("model")
+        tenant = str(payload.get("tenant") or "default")
+        self.metrics.inc_labeled("serve_tenant_requests", tenant)
+        if not isinstance(spec, str) or not spec:
+            return 400, {"error": "submission needs a 'spec' model string"}
+        try:
+            priority = int(payload.get("priority", 0))
+        except (TypeError, ValueError):
+            return 400, {"error": "'priority' must be an integer"}
+
+        try:
+            model = _resolve_spec(spec)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        tensorish = isinstance(model, (TensorModel, TensorModelAdapter))
+        engine = str(payload.get("engine") or "auto")
+        if engine == "auto":
+            engine = "multiplex" if tensorish else "bfs"
+        if engine not in ("multiplex", "tpu_bfs", "bfs"):
+            return 400, {"error": f"unknown engine {engine!r}"}
+        if engine in ("multiplex", "tpu_bfs") and not tensorish:
+            return 400, {
+                "error": f"engine {engine!r} requires a tensor model; "
+                "use engine='bfs' for host models"
+            }
+        signature = model_signature(model) if tensorish else None
+
+        code, body = self._check_quota(tenant)
+        if code is not None:
+            return code, body
+
+        # Speclint admission gate: reject broken specs BEFORE any compile.
+        report = self._lint(spec, signature, model)
+        if not report.ok:
+            self.metrics.inc("serve_rejected_lint")
+            return 422, {
+                "error": "speclint rejected the model "
+                f"({sum(report.counts_by_code().values())} findings)",
+                "diagnostics": report.to_dict(),
+            }
+
+        options: Dict[str, Any] = {}
+        if payload.get("target_max_depth") is not None:
+            try:
+                options["target_max_depth"] = int(payload["target_max_depth"])
+            except (TypeError, ValueError):
+                return 400, {"error": "'target_max_depth' must be an integer"}
+
+        job = Job(tenant, spec, engine, priority, model, signature, options)
+        with self._cv:
+            self._jobs[job.id] = job
+            heapq.heappush(self._heap, (-priority, next(self._seq), job))
+            self._note_submit(tenant)
+            self._update_gauges_locked()
+            self._cv.notify()
+        return 202, {"job_id": job.id, "status": "queued"}
+
+    def _check_quota(self, tenant: str):
+        with self._cv:
+            active = sum(
+                1
+                for j in self._jobs.values()
+                if j.tenant == tenant and j.status in ("queued", "running")
+            )
+            if active >= self.quota_max_active:
+                self.metrics.inc("serve_rejected_quota")
+                return 429, {
+                    "error": f"tenant {tenant!r} has {active} active jobs "
+                    f"(quota {self.quota_max_active})"
+                }
+            window = self._tenant_submits.get(tenant)
+            if window is not None:
+                now = time.monotonic()
+                while window and now - window[0] > _RATE_WINDOW_SECS:
+                    window.popleft()
+                if len(window) >= self.quota_per_minute:
+                    self.metrics.inc("serve_rejected_quota")
+                    return 429, {
+                        "error": f"tenant {tenant!r} exceeded "
+                        f"{self.quota_per_minute} submissions/minute"
+                    }
+        return None, None
+
+    def _note_submit(self, tenant: str) -> None:
+        self._tenant_submits.setdefault(tenant, deque()).append(
+            time.monotonic()
+        )
+
+    def _lint(self, spec: str, signature: Optional[str], model: Any):
+        key = signature or f"spec:{spec}"
+        report = self._lint_cache.get(key)
+        if report is None:
+            builder = model.checker()
+            report = builder.lint(samples=self.lint_samples)
+            self._lint_cache[key] = report
+        return report
+
+    # -- job queries ---------------------------------------------------------
+
+    def job(self, job_id: str) -> Optional[Job]:
+        with self._cv:
+            return self._jobs.get(job_id)
+
+    def jobs(self, tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._cv:
+            return [
+                j.view()
+                for j in self._jobs.values()
+                if tenant is None or j.tenant == tenant
+            ]
+
+    def cancel(self, job_id: str) -> Tuple[int, Dict[str, Any]]:
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return 404, {"error": f"no job {job_id!r}"}
+            if job.status != "queued":
+                return 409, {
+                    "error": f"job {job_id} is {job.status}; only queued "
+                    "jobs cancel"
+                }
+            job.status = "cancelled"
+            job.finished_at = time.time()
+            self.metrics.inc("serve_cancelled")
+            self._update_gauges_locked()
+        return 200, job.view()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            by_status: Dict[str, int] = {}
+            for j in self._jobs.values():
+                by_status[j.status] = by_status.get(j.status, 0) + 1
+            return {
+                "jobs": by_status,
+                "queue_depth": sum(
+                    1 for j in self._jobs.values() if j.status == "queued"
+                ),
+                "paused": self._paused,
+                "cache": self.cache.stats(),
+                "quota": {
+                    "max_active": self.quota_max_active,
+                    "per_minute": self.quota_per_minute,
+                },
+            }
+
+    def telemetry(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()
+        snap["engine"] = "RunService"
+        for name, value in self.cache.stats().items():
+            snap[f"serve_exec_cache_{name}"] = value
+        return snap
+
+    # -- scheduler -----------------------------------------------------------
+
+    def _update_gauges_locked(self) -> None:
+        queued = sum(1 for j in self._jobs.values() if j.status == "queued")
+        running = sum(1 for j in self._jobs.values() if j.status == "running")
+        self.metrics.set_gauge("serve_queue_depth", queued)
+        self.metrics.set_gauge("serve_active_jobs", running)
+
+    def _pop_batch(self) -> Optional[List[Job]]:
+        """Pop the top job; a multiplex job also gathers EVERY queued
+        same-signature multiplex job (any tenant, any priority) into its
+        batch — that sharing is the point of the lane engine. Caller
+        holds the lock."""
+        job: Optional[Job] = None
+        while self._heap:
+            _, _, candidate = heapq.heappop(self._heap)
+            if candidate.status == "queued":  # skip cancelled entries
+                job = candidate
+                break
+        if job is None:
+            return None
+        batch = [job]
+        if job.engine == "multiplex":
+            keep = []
+            for entry in self._heap:
+                mate = entry[2]
+                if (
+                    mate.status == "queued"
+                    and mate.engine == "multiplex"
+                    and mate.signature == job.signature
+                ):
+                    batch.append(mate)
+                else:
+                    keep.append(entry)
+            if len(batch) > 1:
+                heapq.heapify(keep)
+                self._heap = keep
+        now = time.time()
+        for j in batch:
+            j.status = "running"
+            j.started_at = now
+        self._update_gauges_locked()
+        return batch
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and (
+                    self._paused or not self._heap
+                ):
+                    self._cv.wait()
+                if self._stop:
+                    return
+                batch = self._pop_batch()
+            if not batch:
+                continue
+            try:
+                if batch[0].engine == "multiplex":
+                    self._run_multiplex_batch(batch)
+                else:
+                    self._run_solo(batch[0])
+            except Exception as e:
+                self._finish(batch, error=f"{type(e).__name__}: {e}")
+
+    def _finish(self, jobs: List[Job], error: Optional[str] = None) -> None:
+        now = time.time()
+        with self._cv:
+            for j in jobs:
+                j.finished_at = now
+                if error is not None:
+                    j.status = "failed"
+                    j.error = error
+                    self.metrics.inc("serve_failed")
+                else:
+                    j.status = "done"
+                    self.metrics.inc("serve_completed")
+            self._update_gauges_locked()
+            self._cv.notify_all()
+
+    # -- execution -----------------------------------------------------------
+
+    def _cache_get(self, model, engine: str, options: Dict[str, Any]):
+        compiled, hit = self.cache.get(model, engine, **options)
+        self.metrics.inc(
+            "serve_exec_cache_hits" if hit else "serve_exec_cache_misses"
+        )
+        return compiled
+
+    def _run_multiplex_batch(self, jobs: List[Job]) -> None:
+        from ..engines.multiplex import run_multiplexed
+
+        compiled = self._cache_get(
+            jobs[0].model, "multiplex", self.lane_options
+        )
+        builders = []
+        for j in jobs:
+            b = compiled.builder().multiplex_lane(True)
+            if j.options.get("target_max_depth"):
+                b.target_max_depth(j.options["target_max_depth"])
+            builders.append(b)
+        checkers = run_multiplexed(builders, **self.lane_options)
+        for j, checker in zip(jobs, checkers):
+            j.result = self._result_payload(j, checker)
+            self.metrics.inc("serve_multiplexed_jobs")
+        self.metrics.inc(
+            "serve_batches",
+            (len(jobs) + self.lanes - 1) // self.lanes,
+        )
+        self._finish(jobs)
+
+    def _run_solo(self, job: Job) -> None:
+        if job.engine == "tpu_bfs":
+            compiled = self._cache_get(job.model, "tpu_bfs", self.solo_options)
+            builder = compiled.builder()
+            if job.options.get("target_max_depth"):
+                builder.target_max_depth(job.options["target_max_depth"])
+            checker = compiled.spawn(builder).join()
+        else:  # host bfs
+            builder = job.model.checker()
+            if job.options.get("target_max_depth"):
+                builder.target_max_depth(job.options["target_max_depth"])
+            checker = builder.spawn_bfs().join()
+        job.result = self._result_payload(job, checker)
+        self._finish([job])
+
+    def _result_payload(self, job: Job, checker) -> Dict[str, Any]:
+        model = checker.model()
+        expectations = {p.name: p.expectation.value for p in model.properties()}
+        discoveries = {}
+        for name, path in checker.discoveries().items():
+            entry: Dict[str, Any] = {
+                "expectation": expectations.get(name),
+                "depth": len(path),
+                "encoded": path.encode(model),
+            }
+            try:
+                entry["explain"] = path.explain(model)
+            except Exception as e:  # forensics are best-effort
+                entry["explain_error"] = f"{type(e).__name__}: {e}"
+            discoveries[name] = entry
+        return {
+            "engine": job.engine,
+            "state_count": checker.state_count(),
+            "unique_state_count": checker.unique_state_count(),
+            "max_depth": checker.max_depth(),
+            "discoveries": discoveries,
+            "telemetry": checker.telemetry(),
+            "coverage": checker.coverage(),
+        }
