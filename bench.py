@@ -519,6 +519,34 @@ def main() -> None:
         "overhead_pct": round((1.0 - dev_rate / rate_off) * 100.0, 2),
     }
 
+    # Checkpoint cost: the same workload writing periodic crash-safe
+    # checkpoints (atomic tmp+fsync+rename at era boundaries) vs the
+    # plain run above. Both rates land in BENCH json (acceptance:
+    # enabling checkpoints costs < 5%).
+    import tempfile as _tempfile
+
+    with _tempfile.TemporaryDirectory(prefix="_bench_ckpt.") as ckpt_dir:
+        ckpt7 = os.path.join(ckpt_dir, "2pc7.ckpt.npz")
+        med7ck, _spread7ck, dev7ck = timed3(
+            lambda: (
+                TensorModelAdapter(tm7).checker().spawn_tpu_bfs(
+                    checkpoint_path=ckpt7, checkpoint_every=0.5, **opts
+                )
+            ),
+            golden=tpc7_golden,
+        )
+        rate_ck = dev7ck.state_count() / med7ck
+        saves = dev7ck.telemetry().get("checkpoint_saves", 0)
+    ckpt_overhead_pct = (1.0 - rate_ck / dev_rate) * 100.0
+    detail["tpc7_checkpoint_cost"] = {
+        "states_per_sec_checkpoint_on": round(rate_ck, 1),
+        "states_per_sec_checkpoint_off": round(dev_rate, 1),
+        "checkpoint_saves": saves,
+        "overhead_pct": round(ckpt_overhead_pct, 2),
+    }
+    assert saves >= 1, "checkpoint cadence never fired during the bench"
+    assert ckpt_overhead_pct < 5.0, detail["tpc7_checkpoint_cost"]
+
     # Stage profile: ONE extra run with `.stage_profile()` — kept out of
     # the timed3 window above so the isolated-stage microbenches (a few
     # extra dispatches at era shapes) never pollute the headline rate.
@@ -1005,8 +1033,74 @@ def main() -> None:
         }
         assert speedup >= 5.0, detail["service"]
 
+    def _sec_service_durable():
+        # --- serve durability cost: the same 32-check REST batch with the
+        # write-ahead job journal + persisted results enabled (ISSUE 9).
+        # Every submit fsyncs a journal record before the 202 and every
+        # result lands on disk before its terminal journal record, so this
+        # rate IS the durable-path throughput; detail records it next to
+        # the journal/result-store footprints for comparison against the
+        # in-memory-only `service` section above.
+        import json as _json
+        import tempfile as _tempfile
+        import urllib.request
+
+        from stateright_tpu.serve import RunService, ServeServer
+
+        n_checks = 32
+        tmp = _tempfile.mkdtemp(prefix="_bench_serve_dura.")
+        svc = RunService(
+            workers=1,
+            lanes=n_checks,
+            lint_samples=32,
+            journal_path=os.path.join(tmp, "jobs.jsonl"),
+            results_dir=os.path.join(tmp, "results"),
+        )
+        server = ServeServer(svc, "127.0.0.1:0").serve_in_background()
+        base = server.url.rstrip("/")
+
+        def req(method, path, body=None):
+            data = _json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(base + path, data=data, method=method)
+            with urllib.request.urlopen(r) as resp:
+                return _json.loads(resp.read())
+
+        try:
+            req("POST", "/scheduler/pause")
+            ids = [
+                req("POST", "/submit", {"spec": "increment:2"})["job_id"]
+                for _ in range(n_checks)
+            ]
+            t0 = time.perf_counter()
+            req("POST", "/scheduler/resume")
+            while True:
+                views = req("GET", "/jobs")["jobs"]
+                if all(v["status"] not in ("queued", "running") for v in views):
+                    break
+                time.sleep(0.05)
+            dura_secs = time.perf_counter() - t0
+            for job_id in ids:
+                result = req("GET", f"/jobs/{job_id}/result")["result"]
+                assert result["unique_state_count"] == 13, result
+            stats = req("GET", "/stats")
+        finally:
+            server.shutdown()
+        in_memory = (detail.get("service") or {}).get(
+            "multiplexed_checks_per_sec"
+        )
+        durable_rate = n_checks / dura_secs
+        detail["service_durable"] = {
+            "concurrent_checks": n_checks,
+            "durable_checks_per_sec": round(durable_rate, 2),
+            "in_memory_checks_per_sec": in_memory,
+            "journal": stats.get("journal"),
+            "results": stats.get("results"),
+            "golden_match": True,
+        }
+
     section("single_copy4", _sec_single_copy4)
     section("service", _sec_service)
+    section("service_durable", _sec_service_durable)
     section("pbfs_paxos3", _sec_pbfs_paxos3)
     section("tpc10_symmetry", _sec_tpc10_symmetry)
     section("paxos3", _sec_paxos3)

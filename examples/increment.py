@@ -301,6 +301,14 @@ def conform_counter_trace(
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     subcommand = argv[0] if argv else "check"
+    from examples._cli import _pop_flag
+
+    # Durability flags for check-tpu: --checkpoint writes crash-safe
+    # checkpoints (periodically with --checkpoint-every SECONDS, and
+    # always at run end / SIGTERM); --resume continues a killed run.
+    ckpt = _pop_flag(argv, "--checkpoint")
+    ckpt_every = _pop_flag(argv, "--checkpoint-every")
+    resume = _pop_flag(argv, "--resume")
     thread_count = 2
     if subcommand not in ("spawn-record", "conform") and len(argv) > 1:
         thread_count = int(argv[1])
@@ -318,8 +326,18 @@ def main(argv=None):
             WriteReporter(sys.stdout)
         )
     elif subcommand == "check-tpu":
-        checker = IncrementTensor(thread_count).checker().spawn_tpu_bfs().report(
-            WriteReporter(sys.stdout)
+        kw = {}
+        if ckpt is not None:
+            kw["checkpoint_path"] = ckpt
+        if ckpt_every is not None:
+            kw["checkpoint_every"] = float(ckpt_every)
+        if resume is not None:
+            kw["resume_from"] = resume
+        checker = (
+            IncrementTensor(thread_count)
+            .checker()
+            .spawn_tpu_bfs(**kw)
+            .report(WriteReporter(sys.stdout))
         )
         print_coverage(checker)
     elif subcommand == "lint":
@@ -368,6 +386,10 @@ def main(argv=None):
         print(
             "  python examples/increment.py "
             "[check|check-sym|check-tpu|check-actor|lint] [THREAD_COUNT]"
+        )
+        print(
+            "  python examples/increment.py check-tpu [THREAD_COUNT]"
+            " [--checkpoint PATH] [--checkpoint-every SECS] [--resume PATH]"
         )
         print("  python examples/increment.py spawn-record [TRACE] [SECONDS] [SEED]")
         print("  python examples/increment.py conform TRACE [CLIENT_COUNT]")

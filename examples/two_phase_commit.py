@@ -28,11 +28,19 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     subcommand = argv[0] if argv else "check"
 
+    from examples._cli import _pop_flag, print_coverage
+
+    # Durability flags for check-tpu: --checkpoint writes crash-safe
+    # checkpoints (periodically with --checkpoint-every SECONDS, and
+    # always at run end / SIGTERM); --resume continues a killed run.
+    ckpt = _pop_flag(argv, "--checkpoint")
+    ckpt_every = _pop_flag(argv, "--checkpoint-every")
+    resume = _pop_flag(argv, "--resume")
+
     def arg(i, default):
         return argv[1 + i] if len(argv) > 1 + i else default
 
     rm_count = int(arg(0, 3))
-    from examples._cli import print_coverage
 
     if subcommand == "check":
         print(f"Model checking two phase commit with {rm_count} resource managers.")
@@ -53,8 +61,18 @@ def main(argv=None):
             f"Model checking two phase commit with {rm_count} resource managers "
             "on the batched TPU engine."
         )
-        checker = TwoPhaseTensor(rm_count).checker().spawn_tpu_bfs().report(
-            WriteReporter(sys.stdout)
+        kw = {}
+        if ckpt is not None:
+            kw["checkpoint_path"] = ckpt
+        if ckpt_every is not None:
+            kw["checkpoint_every"] = float(ckpt_every)
+        if resume is not None:
+            kw["resume_from"] = resume
+        checker = (
+            TwoPhaseTensor(rm_count)
+            .checker()
+            .spawn_tpu_bfs(**kw)
+            .report(WriteReporter(sys.stdout))
         )
         print_coverage(checker)
     elif subcommand == "lint":
@@ -79,7 +97,10 @@ def main(argv=None):
         print("USAGE:")
         print("  python examples/two_phase_commit.py check [RM_COUNT]")
         print("  python examples/two_phase_commit.py check-sym [RM_COUNT]")
-        print("  python examples/two_phase_commit.py check-tpu [RM_COUNT]")
+        print(
+            "  python examples/two_phase_commit.py check-tpu [RM_COUNT]"
+            " [--checkpoint PATH] [--checkpoint-every SECS] [--resume PATH]"
+        )
         print("  python examples/two_phase_commit.py lint [RM_COUNT]")
         print("  python examples/two_phase_commit.py explore [RM_COUNT] [ADDRESS]")
 

@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Six stages, fail-fast:
+# Seven stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -18,7 +18,11 @@
 #      8 small increment checks over REST, multiplexes the batch into one
 #      fused executable, matches the golden state counts, and reports an
 #      executable-cache hit on resubmission,
-#   6. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   6. a durability smoke: a checkpointed 2pc-5 device run is stopped
+#      mid-flight, resumed from its crash-safe checkpoint to the exact
+#      golden, and a journaled run service is killed with queued jobs and
+#      restarted — every job must recover and finish,
+#   7. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -140,6 +144,65 @@ print(
     f"serve smoke OK: 8 multiplexed + 2pc-3 golden-matched, "
     f"cache {after['hits']} hits / {after['misses']} misses"
 )
+PY
+
+echo "== durability smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import os
+import tempfile
+import time
+
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+from stateright_tpu.serve import RunService
+
+tmp = tempfile.mkdtemp(prefix="_dura_smoke.")
+opts = dict(chunk_size=64, queue_capacity=1 << 12, table_capacity=1 << 11)
+
+# Crash-safe checkpoints: stop a 2pc-5 run mid-flight, then resume the
+# checkpoint to the exact golden (8,832 uniques).
+ckpt = os.path.join(tmp, "2pc5.ckpt.npz")
+part = (
+    TensorModelAdapter(TwoPhaseTensor(5))
+    .checker()
+    .target_state_count(3_000)
+    .spawn_tpu_bfs(checkpoint_path=ckpt, **opts)
+    .join()
+)
+assert 0 < part.unique_state_count() < 8832, part.unique_state_count()
+assert os.path.exists(ckpt)
+resumed = (
+    TensorModelAdapter(TwoPhaseTensor(5))
+    .checker()
+    .spawn_tpu_bfs(resume_from=ckpt, **opts)
+    .join()
+)
+assert resumed.unique_state_count() == 8832, resumed.unique_state_count()
+
+# Serve journal recovery: kill a service with queued jobs, restart on the
+# same journal, and every job must finish with its result served.
+dura = dict(
+    journal_path=os.path.join(tmp, "jobs.jsonl"),
+    results_dir=os.path.join(tmp, "results"),
+)
+svc = RunService(workers=1, lint_samples=32, **dura)
+svc.pause()
+ids = [svc.submit({"spec": "increment:2"})[1]["job_id"] for _ in range(3)]
+svc.shutdown()  # "crash" with everything still queued
+
+svc = RunService(workers=1, lint_samples=32, **dura)
+assert svc.telemetry().get("journal_recovered_queued") == 3
+deadline = time.time() + 600
+while time.time() < deadline:
+    if all(svc.job(i).status not in ("queued", "running") for i in ids):
+        break
+    time.sleep(0.2)
+for i in ids:
+    job = svc.job(i)
+    assert job.status == "done", (i, job.status, job.error)
+    assert job.result["unique_state_count"] == 13, job.result
+svc.shutdown()
+print("durability smoke OK: checkpoint resumed to 8832; 3 jobs recovered")
 PY
 
 echo "== tier-1 tests =="

@@ -91,6 +91,10 @@ class HostEngineBase(Checker):
         self._profile_dir: Optional[str] = getattr(builder, "profile_dir_", None)
         self._last_phase_ms: Dict[str, float] = {}
         self._done = threading.Event()
+        # Graceful-stop request (SIGTERM/SIGINT flush, see
+        # install_signal_checkpoint_flush below): checkpointing engines poll
+        # this at era boundaries, flush a final checkpoint, and exit clean.
+        self._ckpt_stop = threading.Event()
         self._error: Optional[BaseException] = None
         self._deadline = (
             time.monotonic() + self._timeout if self._timeout is not None else None
@@ -172,6 +176,18 @@ class HostEngineBase(Checker):
 
     def is_done(self) -> bool:
         return self._done.is_set()
+
+    def request_checkpoint_stop(self) -> None:
+        """Ask the run to stop at its next era/block boundary, flushing a
+        final checkpoint first (checkpointing engines poll this; engines
+        without checkpoint support simply finish their run). Thread- and
+        signal-safe: only sets an event."""
+        self._ckpt_stop.set()
+
+    def interrupted(self) -> bool:
+        """True when the run stopped early on a graceful-stop request
+        (SIGTERM/SIGINT flush or an explicit request_checkpoint_stop)."""
+        return self._ckpt_stop.is_set() and self._done.is_set()
 
     # -- counters -----------------------------------------------------------
 
@@ -370,3 +386,239 @@ def validate_checkpoint_meta(meta: dict, tm, tprops, exact: dict) -> None:
                 f"this checker's {want!r}; resume with matching engine "
                 "options"
             )
+
+
+# -- crash-safe checkpoint IO (shared by the device engines) ------------------
+#
+# The write protocol: serialize to `<path>.tmp.npz`, fsync the file, rotate
+# the previous generations (`<path>` -> `<path>.1` -> ... -> `<path>.N-1`),
+# rename the tmp over `<path>`, and fsync the directory so the rename itself
+# survives a crash. Every checkpoint carries a sha256 content digest in its
+# meta; the loader recomputes it and rejects truncated/corrupt files with
+# CheckpointCorruptError, falling back to the previous good generation.
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file is unreadable, truncated, or fails its digest."""
+
+
+def validate_checkpoint_cadence(checkpoint_every, checkpoint_path,
+                                keep_checkpoints) -> None:
+    """Builder-time validation of the checkpoint knobs, shared by the
+    device engines. `checkpoint_every` is wall-clock SECONDS between
+    periodic checkpoints (polled at era boundaries); non-positive values
+    are a configuration error, not "checkpoint constantly"."""
+    if checkpoint_every is not None:
+        if checkpoint_path is None:
+            raise ValueError(
+                "checkpoint_every requires checkpoint_path (nothing would "
+                "be written otherwise)"
+            )
+        if not float(checkpoint_every) > 0.0:
+            raise ValueError(
+                "checkpoint_every is wall-clock seconds between periodic "
+                f"checkpoints and must be positive (got {checkpoint_every!r}); "
+                "omit it to checkpoint only at run end"
+            )
+    if keep_checkpoints < 1:
+        raise ValueError(
+            f"keep_checkpoints must be >= 1 (got {keep_checkpoints})"
+        )
+
+
+def _checkpoint_digest(arrays: dict) -> str:
+    """sha256 over every payload array's name, dtype, shape, and bytes
+    (sorted by name; the meta array itself is excluded — it carries the
+    digest)."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        if name == "meta":
+            continue
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def checkpoint_generations(path: str) -> list:
+    """All on-disk generations for `path`, newest first (`path`, then
+    `path.1`, `path.2`, ...)."""
+    import os
+
+    out = [path] if os.path.exists(path) else []
+    g = 1
+    while os.path.exists(f"{path}.{g}"):
+        out.append(f"{path}.{g}")
+        g += 1
+    return out
+
+
+def save_checkpoint_atomic(path: str, meta: dict, arrays: dict, *,
+                           keep: int = 1, metrics=None) -> None:
+    """Write one checkpoint crash-safely: tmp + fsync + generation rotation
+    + rename + directory fsync, with the content digest in the manifest."""
+    import json
+    import os
+
+    import numpy as np
+
+    t0 = time.monotonic()
+    meta = dict(meta)
+    meta["digest"] = _checkpoint_digest(arrays)
+    payload = dict(arrays)
+    payload["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    ).copy()
+    tmp = path + ".tmp.npz"  # savez appends .npz to bare paths otherwise
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    # Rotate the survivors BEFORE the rename lands: the previous good
+    # checkpoint must exist (as `.1`) at every instant a crash could hit.
+    if keep > 1 and os.path.exists(path):
+        for g in range(keep - 1, 1, -1):
+            older = f"{path}.{g - 1}"
+            if os.path.exists(older):
+                os.replace(older, f"{path}.{g}")
+        os.replace(path, f"{path}.1")
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platforms without directory fsync still get the file fsync
+    if metrics is not None:
+        metrics.inc("checkpoint_saves")
+        metrics.inc("checkpoint_bytes", os.path.getsize(path))
+        metrics.add_phase("checkpoint_save", time.monotonic() - t0)
+
+
+def load_checkpoint_verified(path: str):
+    """Load one checkpoint file and verify its content digest. Returns
+    ``(arrays, meta)``; raises CheckpointCorruptError on an unreadable
+    zip, missing/garbled meta, or digest mismatch."""
+    import json
+
+    import numpy as np
+
+    try:
+        data = np.load(path)
+        meta = json.loads(bytes(data["meta"]).decode())
+        arrays = {k: data[k] for k in data.files if k != "meta"}
+    except Exception as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is unreadable (truncated or corrupt): "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+    want = meta.get("digest")
+    if want is None:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} carries no content digest (pre-durability "
+            "layout); re-create it with the current engine"
+        )
+    got = _checkpoint_digest(arrays)
+    if got != want:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} fails its content digest "
+            f"({got[:12]}... != recorded {want[:12]}...); the file is corrupt"
+        )
+    return arrays, meta
+
+
+def load_checkpoint_with_fallback(path: str, metrics=None):
+    """Load the newest verifiable checkpoint generation. A corrupt or
+    truncated `path` falls back to `path.1`, `path.2`, ... (written by
+    `save_checkpoint_atomic(keep=N)`); only when every generation fails
+    does the error propagate, carrying each failure."""
+    import sys
+
+    candidates = checkpoint_generations(path)
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoint at {path!r}")
+    failures = []
+    for cand in candidates:
+        try:
+            arrays, meta = load_checkpoint_verified(cand)
+        except CheckpointCorruptError as exc:
+            failures.append(str(exc))
+            if metrics is not None:
+                metrics.inc("checkpoint_corrupt_rejected")
+            continue
+        if cand != path:
+            if metrics is not None:
+                metrics.inc("checkpoint_fallbacks")
+            print(
+                f"[stateright_tpu] checkpoint {path!r} rejected "
+                f"({failures[-1] if failures else 'missing'}); resuming from "
+                f"previous generation {cand!r}",
+                file=sys.stderr,
+                flush=True,
+            )
+        return arrays, meta
+    raise CheckpointCorruptError(
+        "no loadable checkpoint generation:\n  " + "\n  ".join(failures)
+    )
+
+
+# -- SIGTERM/SIGINT final-checkpoint flush ------------------------------------
+#
+# Preempted runs should resume, not restart: the FIRST signal asks every
+# live checkpointing engine to stop at its next era boundary (each flushes
+# a final checkpoint on the way out; the caller's join() then returns
+# normally with partial results). The previous handler is restored after
+# that first delivery, so a second signal behaves as before (force-kill /
+# KeyboardInterrupt).
+
+_signal_engines = None  # lazy WeakSet; module import must not cost anything
+_signal_installed: Dict[int, Any] = {}
+
+
+def register_signal_checkpoint_flush(engine) -> None:
+    """Enroll a checkpointing engine in the graceful-flush set and install
+    the SIGTERM/SIGINT handlers (first call only; no-op off the main
+    thread, where CPython forbids signal.signal)."""
+    global _signal_engines
+    import signal
+    import weakref
+
+    if _signal_engines is None:
+        _signal_engines = weakref.WeakSet()
+    _signal_engines.add(engine)
+    if _signal_installed:
+        return
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            _signal_installed[signum] = signal.signal(
+                signum, _flush_signal_handler
+            )
+        except ValueError:
+            # Not the main thread (e.g. an engine constructed inside a serve
+            # worker): graceful flush still works via an explicit
+            # request_checkpoint_stop(); only the OS hook is unavailable.
+            _signal_installed.clear()
+            return
+
+
+def _flush_signal_handler(signum, frame) -> None:
+    import signal
+
+    for engine in list(_signal_engines or ()):
+        engine.request_checkpoint_stop()
+    # One graceful chance: restore the previous handlers so the next
+    # signal is forceful.
+    for num, prev in _signal_installed.items():
+        try:
+            signal.signal(num, prev if prev is not None else signal.SIG_DFL)
+        except (ValueError, TypeError):
+            pass
+    _signal_installed.clear()

@@ -24,7 +24,13 @@ budget raises with guidance to raise the capacities or run solo
 (`spawn_tpu_bfs` exists precisely for those).
 
 Deliberate non-goals (run solo instead): symmetry reduction, visitors,
-timeouts, state-count targets, tracing, checkpoints, stage profiling.
+timeouts, state-count targets, tracing, stage profiling.
+
+Durability: `run_multiplexed(checkpoint_path=...)` snapshots every
+completed batch of lanes (the per-lane result vectors + lane tables) via
+the crash-safe protocol in engines/common.py; `resume_from=` skips the
+batches whose snapshots verify and re-runs only the rest, so a killed
+thousand-check sweep resumes instead of restarting.
 """
 
 from __future__ import annotations
@@ -299,10 +305,15 @@ class _TableBundle:
         self._np: Optional[np.ndarray] = None
 
     def lane(self, i: int):
+        return tuple(self.asarray()[i][t] for t in range(4))
+
+    def asarray(self) -> np.ndarray:
+        """The whole [lanes, 4, tcap] stack on host (downloaded once) —
+        also what the batch progress snapshot persists."""
         if self._np is None:
             self._np = np.asarray(self._dev)
             self._dev = None
-        return tuple(self._np[i][t] for t in range(4))
+        return self._np
 
 
 def _reject_unsupported(builder: CheckerBuilder) -> None:
@@ -324,6 +335,54 @@ def _reject_unsupported(builder: CheckerBuilder) -> None:
         )
 
 
+def _batch_snapshot_path(base: str, off: int) -> str:
+    return f"{base}.batch{off}.npz"
+
+
+def _save_batch_snapshot(base: str, off: int, n: int, tm: TensorModel,
+                         tprops, shape: dict, vals: np.ndarray,
+                         tables_np: np.ndarray) -> None:
+    from .common import checkpoint_meta, save_checkpoint_atomic
+
+    meta = checkpoint_meta(
+        tm, tprops, batch_off=off, batch_n=n, **shape
+    )
+    save_checkpoint_atomic(
+        _batch_snapshot_path(base, off), meta,
+        {"vals": vals, "tables": tables_np},
+    )
+
+
+def _load_batch_snapshot(base: str, off: int, n: int, tm: TensorModel,
+                         tprops, shape: dict):
+    """A verifiable snapshot of this exact batch, or None (missing or
+    corrupt snapshots simply re-run the batch — progress snapshots are an
+    optimization, never a correctness dependency)."""
+    import os
+
+    from .common import (
+        CheckpointCorruptError,
+        load_checkpoint_verified,
+        validate_checkpoint_meta,
+    )
+
+    path = _batch_snapshot_path(base, off)
+    if not os.path.exists(path):
+        return None
+    try:
+        arrays, meta = load_checkpoint_verified(path)
+        validate_checkpoint_meta(
+            meta, tm, tprops,
+            exact={
+                "batch_off": off, "batch_n": n,
+                "state_width": tm.state_width, **shape,
+            },
+        )
+    except (CheckpointCorruptError, ValueError):
+        return None
+    return arrays
+
+
 def run_multiplexed(
     builders: List[CheckerBuilder],
     *,
@@ -332,6 +391,8 @@ def run_multiplexed(
     queue_capacity: int = 1 << 13,
     table_capacity: int = 1 << 16,
     init_capacity: int = 64,
+    checkpoint_path: Optional[str] = None,
+    resume_from: Optional[str] = None,
 ) -> List[MultiplexLaneChecker]:
     """Run every builder's check as one lane of a fused vmapped era.
 
@@ -340,6 +401,11 @@ def run_multiplexed(
     them all. Batches larger than `lanes` run as multiple dispatches of
     the same (padded) executable; smaller batches pad with empty lanes.
     Returns one `MultiplexLaneChecker` per builder, in order.
+
+    `checkpoint_path` writes one crash-safe progress snapshot per
+    COMPLETED batch (`<path>.batch<off>.npz`: per-lane result vectors +
+    lane tables); `resume_from` rebuilds lanes from every snapshot that
+    verifies and dispatches only the remaining batches.
     """
     import jax.numpy as jnp
 
@@ -422,32 +488,50 @@ def run_multiplexed(
         t[P_GROW_LIMIT] = max(0, int(vs.MAX_LOAD * tcap) - vcap)
         return t
 
-    program = _build_lane_program(tm, tprops, lanes, chunk, qcap, tcap, icap, cov)
+    # The snapshot identity: a batch snapshot only resumes under the exact
+    # lane geometry that wrote it (different shapes compile different
+    # programs and lay tables out differently).
+    shape = dict(lanes=lanes, chunk=chunk, qcap=qcap, tcap=tcap,
+                 icap=icap, cov=cov)
+    program = None  # built lazily: a fully-resumed sweep never compiles
     model = TensorModelAdapter(tm)
     out: List[MultiplexLaneChecker] = []
 
     for off in range(0, len(builders), lanes):
         batch = builders[off : off + lanes]
         n = len(batch)
-        qinit = np.zeros((lanes, W, icap), dtype=np.uint32)
-        qinit[:n] = qinit_row
-        n_inits = np.zeros(lanes, dtype=np.uint32)
-        n_inits[:n] = n_init
-        h1 = np.zeros((lanes, icap), dtype=np.uint32)
-        h2 = np.zeros((lanes, icap), dtype=np.uint32)
-        h1[:n] = h1_row
-        h2[:n] = h2_row
-        params = np.zeros((lanes, plen), dtype=np.uint32)
-        for i, b in enumerate(batch):
-            params[i] = lane_params(b)
-        rec_fp = jnp.zeros((lanes, P), dtype=jnp.uint32)
+        vals = None
+        resumed = False
+        if resume_from is not None:
+            snap = _load_batch_snapshot(resume_from, off, n, tm, tprops, shape)
+            if snap is not None:
+                vals = snap["vals"]
+                tables = _TableBundle(snap["tables"])
+                resumed = True
+        if vals is None:
+            if program is None:
+                program = _build_lane_program(
+                    tm, tprops, lanes, chunk, qcap, tcap, icap, cov
+                )
+            qinit = np.zeros((lanes, W, icap), dtype=np.uint32)
+            qinit[:n] = qinit_row
+            n_inits = np.zeros(lanes, dtype=np.uint32)
+            n_inits[:n] = n_init
+            h1 = np.zeros((lanes, icap), dtype=np.uint32)
+            h2 = np.zeros((lanes, icap), dtype=np.uint32)
+            h1[:n] = h1_row
+            h2[:n] = h2_row
+            params = np.zeros((lanes, plen), dtype=np.uint32)
+            for i, b in enumerate(batch):
+                params[i] = lane_params(b)
+            rec_fp = jnp.zeros((lanes, P), dtype=jnp.uint32)
 
-        tables_dev, params_dev = program(
-            jnp.asarray(qinit), jnp.asarray(n_inits), jnp.asarray(h1),
-            jnp.asarray(h2), jnp.asarray(params), rec_fp, rec_fp,
-        )
-        vals = np.asarray(params_dev)  # ONE readback for the whole batch
-        tables = _TableBundle(tables_dev)
+            tables_dev, params_dev = program(
+                jnp.asarray(qinit), jnp.asarray(n_inits), jnp.asarray(h1),
+                jnp.asarray(h2), jnp.asarray(params), rec_fp, rec_fp,
+            )
+            vals = np.asarray(params_dev)  # ONE readback for the whole batch
+            tables = _TableBundle(tables_dev)
 
         for i, b in enumerate(batch):
             v = vals[i]
@@ -474,4 +558,13 @@ def run_multiplexed(
                     "spawn_tpu_bfs"
                 )
             out.append(checker)
+        # Snapshot only after every lane of the batch validated: a snapshot
+        # asserts "this batch is done and correct", never partial work.
+        if checkpoint_path is not None and not (
+            resumed and checkpoint_path == resume_from
+        ):
+            _save_batch_snapshot(
+                checkpoint_path, off, n, tm, tprops, shape,
+                vals, tables.asarray(),
+            )
     return out

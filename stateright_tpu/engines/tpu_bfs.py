@@ -54,7 +54,19 @@ from ..core import Expectation
 from ..fingerprint import combine64, split64
 from ..path import Path
 from ..tensor import TensorModel, TensorModelAdapter
-from .common import HostEngineBase
+from .common import (
+    HostEngineBase,
+    load_checkpoint_with_fallback,
+    register_signal_checkpoint_flush,
+    save_checkpoint_atomic,
+    validate_checkpoint_cadence,
+)
+
+
+class _ProbeBudgetExhausted(RuntimeError):
+    """An era closed with unresolved inserts (probe budget exhausted).
+    Recoverable when a crash-safe checkpoint exists: reload it, regrow the
+    table, and re-run the lost era (graceful degradation)."""
 
 
 # Loop cache: (id(tm), chunk, qcap, n_props) -> (tm ref, jitted loop). Reusing
@@ -876,6 +888,7 @@ class TpuBfsChecker(HostEngineBase):
         checkpoint_path: Optional[str] = None,
         checkpoint_every: Optional[float] = None,
         resume_from: Optional[str] = None,
+        keep_checkpoints: int = 2,
         compiled=None,
     ):
         model = builder.model
@@ -944,16 +957,24 @@ class TpuBfsChecker(HostEngineBase):
         self._max_sync_steps = sync_steps
         # Checkpoint/resume: a capability the reference lacks (its runs are
         # in-memory only, SURVEY.md §5) — the dense table/ring layout makes
-        # a checkpoint a straight array download.
-        if checkpoint_every is not None and checkpoint_path is None:
-            raise ValueError(
-                "checkpoint_every requires checkpoint_path (nothing would "
-                "be written otherwise)"
-            )
+        # a checkpoint a straight array download. Writes are crash-atomic
+        # with rolling generations and a content digest (engines/common.py);
+        # checkpoint_every is wall-clock seconds, polled at era boundaries.
+        validate_checkpoint_cadence(
+            checkpoint_every, checkpoint_path, keep_checkpoints
+        )
         self._ckpt_path = checkpoint_path
         self._ckpt_every = checkpoint_every
+        self._ckpt_keep = keep_checkpoints
         self._resume_from = resume_from
         self._last_ckpt = time.monotonic()
+        # Chaos-injection hook (tests/test_durability_chaos.py): pretend the
+        # probe budget was exhausted once this era count is reached,
+        # exercising the degraded-regrow recovery without needing a
+        # pathological probe sequence.
+        self._chaos_probe_error_era: Optional[int] = None
+        if checkpoint_path is not None:
+            register_signal_checkpoint_flush(self)
         self._cov = self._coverage.enabled
         self._loop = _build_loop(
             self.tm, self._tprops, self._chunk, self._qcap, self._canon,
@@ -1163,20 +1184,28 @@ class TpuBfsChecker(HostEngineBase):
                 f"era result steps={vals[10]} gen={vals[8]} count={vals[1]} "
                 f"unique={vals[2]} rec={vals[3]:b}"
             )
-            if int(vals[11]):
+            err = int(vals[11])
+            if not err and self._chaos_probe_error_era is not None and (
+                self._metrics.get("eras") >= self._chaos_probe_error_era
+            ):
+                self._chaos_probe_error_era = None
+                err = 1
+            if err:
                 # Cannot happen with the proactive growth short of a
                 # pathological probe sequence; losing states would be an
-                # unsound "verified", so fail loudly. A nonzero error with
-                # ZERO steps on the first era means the unresolved count
-                # flowed in from the seeder (init-state insert), not the
-                # era loop — attribute it correctly.
+                # unsound "verified", so the era's work must be discarded.
+                # A nonzero error with ZERO steps on the first era means the
+                # unresolved count flowed in from the seeder (init-state
+                # insert), not the era loop — attribute it correctly.
                 if self._metrics.get("eras") == 0 and int(vals[10]) == 0:
                     raise RuntimeError(
                         "init-state seeding exhausted the visited-table "
                         "probe budget (duplicate-heavy or adversarial "
                         "initial fingerprints); raise table_capacity"
                     )
-                raise RuntimeError(
+                # Recoverable when a checkpoint exists: the while loop
+                # reloads the pre-era state, regrows, and re-runs.
+                raise _ProbeBudgetExhausted(
                     "visited-table probe budget exhausted despite headroom"
                 )
             head = int(vals[0])
@@ -1277,9 +1306,19 @@ class TpuBfsChecker(HostEngineBase):
                 stop = True
             elif self._timed_out():
                 stop = True
+            elif self._ckpt_stop.is_set():
+                # Graceful-stop request (SIGTERM/SIGINT flush): exit the
+                # loop; the final checkpoint below captures this boundary.
+                self._metrics.set_gauge("interrupted", 1)
+                stop = True
 
         if first_result_pending:
             process_result()
+
+        # Graceful degradation budget: each recovery doubles the table, so
+        # a handful of rounds covers any realistic exhaustion; an unbounded
+        # loop would mask a genuinely pathological model.
+        regrow_budget = 8
 
         while not stop and (count > 0 or self._spill):
             host_dirty = params_dev is None
@@ -1370,7 +1409,34 @@ class TpuBfsChecker(HostEngineBase):
                 f"block dirty={host_dirty} max_steps={max_steps} "
                 f"dispatch={time.monotonic() - _t0:.3f}s"
             )
-            process_result()
+            try:
+                process_result()
+            except _ProbeBudgetExhausted:
+                # Graceful degradation (degraded_regrow): discard the failed
+                # era, reload the last crash-safe checkpoint (the pre-era
+                # state), double the table, and continue — instead of
+                # aborting the whole run. Only possible with a checkpoint:
+                # the consumed frontier rows are otherwise gone.
+                from .common import checkpoint_generations
+
+                if (
+                    self._ckpt_path is None
+                    or regrow_budget == 0
+                    or not checkpoint_generations(self._ckpt_path)
+                ):
+                    raise
+                regrow_budget -= 1
+                table, queue, head, count, rec_bits, rec_fp1, rec_fp2 = (
+                    self._load_checkpoint(self._ckpt_path, W)
+                )
+                with self._metrics.phase("table_grow"):
+                    table, self._tcap = self._grow_table(table)
+                self._metrics.inc("degraded_regrow")
+                self._metrics.inc("table_growths")
+                self._obs_event(
+                    "degraded_regrow", frontier=count, new_tcap=self._tcap
+                )
+                params_dev = None  # host state changed; force re-upload
 
         # A final checkpoint makes interrupted runs (targets, timeouts)
         # resumable from their exact stopping point.
@@ -1462,11 +1528,11 @@ class TpuBfsChecker(HostEngineBase):
         self, table, queue, head, count, rec_bits, rec_fp1, rec_fp2
     ) -> None:
         """Serialize the full engine state (table, ring, spill, counters) to
-        one .npz; written atomically so a kill mid-save never corrupts the
-        previous checkpoint. The reference has no equivalent — killed runs
-        restart from scratch (SURVEY.md §5)."""
-        import json
-
+        one .npz via the crash-safe protocol in engines/common.py: tmp +
+        fsync + generation rotation + rename, content digest in the meta.
+        The reference has no equivalent — killed runs restart from scratch
+        (SURVEY.md §5)."""
+        from ..ops import visited_set as vs
         from .common import checkpoint_meta
 
         meta = checkpoint_meta(
@@ -1482,12 +1548,10 @@ class TpuBfsChecker(HostEngineBase):
             tcap=self._tcap,
             qcap=self._qcap,
             chunk=self._chunk,
+            max_probes=vs.MAX_PROBES,
             discovery_fps={k: str(v) for k, v in self._discovery_fps.items()},
         )
         arrays = {
-            "meta": np.frombuffer(
-                json.dumps(meta).encode(), dtype=np.uint8
-            ).copy(),
             "rec_fp1": np.asarray(rec_fp1),
             "rec_fp2": np.asarray(rec_fp2),
         }
@@ -1497,21 +1561,22 @@ class TpuBfsChecker(HostEngineBase):
             arrays[f"queue{w}"] = np.asarray(lane)
         for i, blk in enumerate(self._spill):
             arrays[f"spill{i}"] = blk
-        tmp = self._ckpt_path + ".tmp.npz"  # savez appends .npz otherwise
-        np.savez_compressed(tmp, **arrays)
-        os.replace(tmp, self._ckpt_path)
+        save_checkpoint_atomic(
+            self._ckpt_path, meta, arrays,
+            keep=self._ckpt_keep, metrics=self._metrics,
+        )
         self._last_ckpt = time.monotonic()
         _dbg(f"checkpoint saved: {self._ckpt_path}")
 
     def _load_checkpoint(self, path: str, W: int):
-        import json
-
         import jax.numpy as jnp
 
+        from ..ops import visited_set as vs
         from .common import validate_checkpoint_meta
 
-        data = np.load(path)
-        meta = json.loads(bytes(data["meta"]).decode())
+        # Digest-verified load with automatic fallback to the previous
+        # generation when the newest file is truncated/corrupt.
+        data, meta = load_checkpoint_with_fallback(path, metrics=self._metrics)
         validate_checkpoint_meta(
             meta,
             self.tm,
@@ -1522,6 +1587,10 @@ class TpuBfsChecker(HostEngineBase):
                 # Ring layout changed in round 5 (hashes no longer carried);
                 # checkpoints from the old layout must not load silently.
                 "ring_lanes": W,
+                # The probe cascade is part of the table's on-disk meaning:
+                # a table written under a different probe schedule would
+                # mis-resolve lookups.
+                "max_probes": vs.MAX_PROBES,
             },
         )
         self._tcap = meta["tcap"]
@@ -1533,7 +1602,7 @@ class TpuBfsChecker(HostEngineBase):
         }
         self._spill = [
             data[k] for k in sorted(
-                (k for k in data.files if k.startswith("spill")),
+                (k for k in data if k.startswith("spill")),
                 key=lambda s: int(s[5:]),
             )
         ]

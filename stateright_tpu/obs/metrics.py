@@ -54,6 +54,40 @@ Counters (`inc`) — monotonic totals:
                          tenant id — rendered as a labeled
                          ``{tenant="..."}`` series in the Prometheus
                          exposition
+  ``checkpoint_saves``   crash-safe checkpoints written (tmp + fsync +
+                         generation rotation + rename; engines/common.py)
+  ``checkpoint_bytes``   total bytes of checkpoint payloads written
+  ``checkpoint_corrupt_rejected``  checkpoint generations rejected by the
+                         content digest (truncated/corrupt files)
+  ``checkpoint_fallbacks``  resumes that fell back to a previous rolling
+                         generation after the newest failed verification
+  ``degraded_regrow``    probe-budget exhaustions recovered by reloading
+                         the last checkpoint and doubling the table
+                         instead of aborting (graceful degradation)
+  ``journal_records`` / ``journal_bytes``  serve job-journal appends /
+                         bytes fsynced (serve/durability.py)
+  ``journal_compactions``  atomic journal rewrites to the folded state
+  ``journal_replayed_jobs``  jobs reconstructed from the journal at
+                         service restart
+  ``journal_recovered_queued``  replayed jobs re-enqueued (were queued)
+  ``journal_recovered_running``  replayed jobs re-enqueued as retries
+                         (were mid-flight when the service died)
+  ``journal_recovered_done``  replayed jobs whose persisted results were
+                         reloaded without re-running
+  ``retry_scheduled``    transient job failures scheduled for a backoff
+                         retry (invisible to the client)
+  ``retry_escalated_solo``  retries escalated from a multiplex lane to
+                         the solo engine (lane capacity failures)
+  ``retry_exhausted``    transient failures out of retry attempts
+                         (surfaced as failed)
+  ``serve_breaker_fastfail``  jobs fast-failed by an open per-signature
+                         circuit breaker
+  ``serve_worker_crashes``  dead worker threads detected and replaced by
+                         the guard
+  ``serve_admin_retries``  ``POST /jobs/{id}/retry`` re-enqueues
+  ``serve_results_persisted``  finished result payloads written to the
+                         on-disk result store
+  ``serve_results_gc``   persisted results expired past their TTL
   =====================  =====================================================
 
 Gauges (`set_gauge`) — last-observed values:
@@ -101,6 +135,10 @@ Gauges (`set_gauge`) — last-observed values:
                            (profiling is best-effort and never fails a run)
   ``serve_queue_depth``    run-service jobs currently queued (serve/)
   ``serve_active_jobs``    run-service jobs currently executing
+  ``interrupted``          set to 1 when a run stopped early for a graceful
+                           SIGTERM/SIGINT checkpoint flush
+                           (`request_checkpoint_stop`); the final
+                           checkpoint captures the stopping boundary
   =======================  ===================================================
 
 Phase timers (`phase(name)` context manager / `add_phase`) — cumulative
@@ -116,6 +154,8 @@ dict in `snapshot()`:
   ``spill``              frontier spill downloads (device -> host)
   ``refill``             frontier refill uploads (host -> device)
   ``table_grow``         visited-table grow + rehash
+  ``checkpoint_save``    one crash-safe checkpoint write end-to-end
+                         (serialize + fsync + rotate + rename)
   ``check_block``        one host BFS/DFS/on-demand block (pop..expand)
   ``property_eval``      batched property evaluation (vbfs)
   ``expand``             batched successor generation (vbfs)

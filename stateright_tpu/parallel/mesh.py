@@ -768,6 +768,7 @@ class ShardedBfsChecker(HostEngineBase):
         checkpoint_path: Optional[str] = None,
         checkpoint_every: Optional[float] = None,
         resume_from: Optional[str] = None,
+        keep_checkpoints: int = 2,
     ):
         import jax
         from jax.sharding import Mesh
@@ -821,19 +822,30 @@ class ShardedBfsChecker(HostEngineBase):
         self._spill: List[List[np.ndarray]] = [[] for _ in range(self.n_shards)]
         # Sharded checkpoint/resume: per-shard tables, rings, spill lists,
         # take_caps and counters serialize to one .npz at block boundaries
-        # (all arrays are host-visible there). The reference has no
-        # equivalent — killed runs restart from scratch.
-        if checkpoint_every is not None and checkpoint_path is None:
-            raise ValueError(
-                "checkpoint_every requires checkpoint_path (nothing would "
-                "be written otherwise)"
-            )
+        # (all arrays are host-visible there). Writes are crash-atomic with
+        # rolling generations and a content digest (engines/common.py);
+        # checkpoint_every is wall-clock seconds, polled at era boundaries.
+        from ..engines.common import (
+            register_signal_checkpoint_flush,
+            validate_checkpoint_cadence,
+        )
+
+        validate_checkpoint_cadence(
+            checkpoint_every, checkpoint_path, keep_checkpoints
+        )
         self._ckpt_path = checkpoint_path
         self._ckpt_every = checkpoint_every
+        self._ckpt_keep = keep_checkpoints
         self._resume_from = resume_from
         import time as _time
 
         self._last_ckpt = _time.monotonic()
+        # Chaos-injection hook (tests/test_durability_chaos.py): fake a
+        # probe-budget exhaustion at this era count to exercise the
+        # degraded-regrow recovery.
+        self._chaos_probe_error_era: Optional[int] = None
+        if checkpoint_path is not None:
+            register_signal_checkpoint_flush(self)
         self._init_ebits = 0
         e = 0
         for p in self._tprops:
@@ -974,6 +986,9 @@ class ShardedBfsChecker(HostEngineBase):
         # to a margin below the watermark so spilling runs still get long
         # eras between host round-trips.
         spill_target = max(high_water // 2, high_water - 64 * N * self._quota)
+        # Graceful-degradation budget: each recovery doubles every shard
+        # table, so a handful of rounds covers any realistic exhaustion.
+        regrow_budget = 8
 
         while counts.sum() > 0 or any(self._spill[s] for s in range(N)):
             # Refill spills per shard (one batched upload per shard).
@@ -1049,10 +1064,44 @@ class ShardedBfsChecker(HostEngineBase):
                 with self._metrics.phase("readback"):
                     vals = np.asarray(params)  # the one download per block
 
-            if vals[:, P_ERR].any():
-                raise RuntimeError(
-                    "visited-table probe budget exhausted despite headroom"
+            err = bool(vals[:, P_ERR].any())
+            if not err and self._chaos_probe_error_era is not None and (
+                self._metrics.get("eras") >= self._chaos_probe_error_era
+            ):
+                self._chaos_probe_error_era = None
+                err = True
+            if err:
+                # Graceful degradation (degraded_regrow): the failed era's
+                # work is unsound (unresolved inserts dropped states), so
+                # discard it — reload the last crash-safe checkpoint,
+                # double every shard table, and continue. Without a
+                # checkpoint the consumed frontier rows are gone: abort.
+                from ..engines.common import checkpoint_generations
+
+                if (
+                    self._ckpt_path is None
+                    or regrow_budget == 0
+                    or not checkpoint_generations(self._ckpt_path)
+                ):
+                    raise RuntimeError(
+                        "visited-table probe budget exhausted despite "
+                        "headroom"
+                    )
+                regrow_budget -= 1
+                (
+                    table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
+                    take_caps, disc_depth_best, per_shard_unique,
+                ) = self._load_checkpoint(self._ckpt_path, W)
+                with self._metrics.phase("table_grow"):
+                    table = self._grow_tables(table)
+                self._metrics.inc("degraded_regrow")
+                self._metrics.inc("table_growths")
+                self._obs_event(
+                    "degraded_regrow",
+                    frontier=int(counts.sum()),
+                    new_tcap=self._tcap,
                 )
+                continue
             heads = vals[:, P_HEAD].astype(np.int64)
             counts = vals[:, P_COUNT].astype(np.int64)
             take_caps = list(vals[:, P_TAKE_CAP].astype(np.int64))
@@ -1155,6 +1204,12 @@ class ShardedBfsChecker(HostEngineBase):
                 break
             if self._timed_out():
                 break
+            if self._ckpt_stop.is_set():
+                # Graceful-stop request (SIGTERM/SIGINT flush): the final
+                # checkpoint below captures this era boundary — the same
+                # path timeout/target stops take.
+                self._metrics.set_gauge("interrupted", 1)
+                break
 
         if self._ckpt_path is not None:
             self._save_checkpoint(
@@ -1217,14 +1272,15 @@ class ShardedBfsChecker(HostEngineBase):
         take_caps, disc_depth_best, per_shard_unique,
     ) -> None:
         """Serialize the full sharded engine state (per-shard tables, rings,
-        spill lists, take_caps, counters) to one .npz, written atomically.
-        Mirrors the single-device engine's checkpoint (engines/tpu_bfs.py);
-        the reference has no equivalent."""
-        import json
-        import os
+        spill lists, take_caps, counters) to one .npz via the crash-safe
+        protocol in engines/common.py (tmp + fsync + generation rotation +
+        rename, content digest in the meta). Mirrors the single-device
+        engine's checkpoint (engines/tpu_bfs.py); the reference has no
+        equivalent."""
         import time as _time
 
-        from ..engines.common import checkpoint_meta
+        from ..engines.common import checkpoint_meta, save_checkpoint_atomic
+        from ..ops import visited_set as vs
 
         meta = checkpoint_meta(
             self.tm,
@@ -1235,6 +1291,7 @@ class ShardedBfsChecker(HostEngineBase):
             tcap=self._tcap,
             chunk=self._chunk,
             quota=self._quota,
+            max_probes=vs.MAX_PROBES,
             rec_bits=rec_bits,
             state_count=self._state_count,
             unique=self._unique,
@@ -1245,9 +1302,6 @@ class ShardedBfsChecker(HostEngineBase):
             take_caps=[int(t) for t in take_caps],
         )
         arrays = {
-            "meta": np.frombuffer(
-                json.dumps(meta).encode(), dtype=np.uint8
-            ).copy(),
             "heads": np.asarray(heads, dtype=np.int64),
             "counts": np.asarray(counts, dtype=np.int64),
             "rec_fp1": np.asarray(rec_fp1),
@@ -1260,20 +1314,24 @@ class ShardedBfsChecker(HostEngineBase):
         for s in range(self.n_shards):
             for i, blk in enumerate(self._spill[s]):
                 arrays[f"spill_{s}_{i}"] = blk
-        tmp = self._ckpt_path + ".tmp.npz"
-        np.savez_compressed(tmp, **arrays)
-        os.replace(tmp, self._ckpt_path)
+        save_checkpoint_atomic(
+            self._ckpt_path, meta, arrays,
+            keep=self._ckpt_keep, metrics=self._metrics,
+        )
         self._last_ckpt = _time.monotonic()
 
     def _load_checkpoint(self, path: str, W: int):
-        import json
-
         import jax.numpy as jnp
 
-        from ..engines.common import validate_checkpoint_meta
+        from ..engines.common import (
+            load_checkpoint_with_fallback,
+            validate_checkpoint_meta,
+        )
+        from ..ops import visited_set as vs
 
-        data = np.load(path)
-        meta = json.loads(bytes(data["meta"]).decode())
+        # Digest-verified load with automatic fallback to the previous
+        # generation when the newest file is truncated/corrupt.
+        data, meta = load_checkpoint_with_fallback(path, metrics=self._metrics)
         validate_checkpoint_meta(
             meta,
             self.tm,
@@ -1289,6 +1347,8 @@ class ShardedBfsChecker(HostEngineBase):
                 "quota": self._quota,
                 # Ring layout changed in round 5 (hashes no longer carried).
                 "ring_lanes": W,
+                # The probe cascade is part of the table's on-disk meaning.
+                "max_probes": vs.MAX_PROBES,
             },
         )
         self._tcap = meta["tcap"]
@@ -1300,7 +1360,7 @@ class ShardedBfsChecker(HostEngineBase):
         }
         for s in range(self.n_shards):
             blocks = sorted(
-                (k for k in data.files if k.startswith(f"spill_{s}_")),
+                (k for k in data if k.startswith(f"spill_{s}_")),
                 key=lambda n: int(n.rsplit("_", 1)[1]),
             )
             self._spill[s] = [data[k] for k in blocks]

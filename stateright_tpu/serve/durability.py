@@ -1,0 +1,448 @@
+"""Serve-layer durability: job journal, result store, retry, breaker.
+
+Four self-contained pieces that `RunService` (serve/service.py) composes
+so a restarted service loses nothing and a transient failure never
+becomes a client-visible error:
+
+  JobJournal     a JSONL write-ahead log of job lifecycle records
+                 (submit / start / result / cancel / retry). Every
+                 append is flushed + fsynced before the state change it
+                 describes is acknowledged; `replay()` folds the log
+                 back into per-job states on restart, and `compact()`
+                 atomically rewrites it to one folded record per live
+                 job so the log stays bounded.
+  ResultStore    finished result payloads as one JSON file per job
+                 (atomic tmp+replace writes), so a restarted service
+                 serves completed results without re-running anything;
+                 `gc()` expires files past a TTL.
+  RetryPolicy    exponential backoff with DETERMINISTIC jitter: the
+                 delay for (attempt, key) is a pure function of the
+                 policy seed, so tests and incident forensics can
+                 reproduce exact schedules. `classify_failure` decides
+                 which errors are transient (resource exhaustion,
+                 worker crashes, interrupts) and which must escalate a
+                 lane job to a solo engine with real capacity.
+  CircuitBreaker classic closed -> open -> half-open per key (model
+                 signature): after `threshold` consecutive failures the
+                 key fast-fails for `cooldown` seconds, then ONE trial
+                 is admitted; success closes, failure re-opens. The
+                 clock is injectable for deterministic tests.
+
+Journal record shapes (one JSON object per line)::
+
+  {"rec": "submit", "t": ..., "job": {"id", "tenant", "spec", "engine",
+                                      "priority", "options"}}
+  {"rec": "start",  "t": ..., "job_id": ..., "attempt": N}
+  {"rec": "result", "t": ..., "job_id": ..., "status": "done"|"failed",
+                    "error": ...?}
+  {"rec": "cancel", "t": ..., "job_id": ...}
+  {"rec": "retry",  "t": ..., "job_id": ...}
+
+A truncated final line (kill mid-append) is ignored; every complete
+prefix of the log folds to a consistent state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "CircuitBreaker",
+    "JobJournal",
+    "ResultStore",
+    "RetryPolicy",
+    "classify_failure",
+]
+
+
+# ---------------------------------------------------------------------------
+# Failure classification
+# ---------------------------------------------------------------------------
+
+# Substrings marking a failure as TRANSIENT: worth retrying, because the
+# retry runs under different conditions (bigger tables after escalation, a
+# fresh worker, freed device memory) rather than deterministically
+# re-failing. Speclint rejections, bad specs, and model bugs are NOT here.
+_TRANSIENT_MARKERS = (
+    "probe budget",          # visited-table exhaustion (engines raise this)
+    "lane budget",           # lane outgrew its fixed shape
+    "did not complete within the lane",
+    "table_capacity",        # capacity guidance in engine errors
+    "queue_capacity",
+    "resource_exhausted",    # XLA OOM spelling
+    "out of memory",
+    "worker crashed",
+    "interrupted",
+)
+
+# Substrings that additionally mean "this shape is too small, run solo":
+# retrying the same multiplex lane would hit the identical wall, but the
+# solo engine sizes tables dynamically (growth + spill) and succeeds.
+_ESCALATE_MARKERS = (
+    "lane budget",
+    "did not complete within the lane",
+    "probe budget",
+    "run it solo",
+)
+
+
+def classify_failure(error: str) -> Tuple[bool, bool]:
+    """``(transient, escalate_solo)`` for an error string."""
+    low = error.lower()
+    transient = any(m in low for m in _TRANSIENT_MARKERS)
+    escalate = transient and any(m in low for m in _ESCALATE_MARKERS)
+    return transient, escalate
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic, per-key jitter."""
+
+    def __init__(self, *, max_attempts: int = 3, base_delay: float = 0.05,
+                 max_delay: float = 5.0, jitter: float = 0.5, seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if base_delay <= 0 or max_delay < base_delay:
+            raise ValueError("need 0 < base_delay <= max_delay")
+        if not 0 <= jitter <= 1:
+            raise ValueError("jitter is a fraction in [0, 1]")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.seed = seed
+
+    def delay(self, attempt: int, key: str = "") -> float:
+        """Backoff before retry number `attempt` (1-based: the delay
+        after the first failure is ``delay(1)``). Deterministic: the
+        jitter fraction is a hash of (seed, key, attempt), so the same
+        job always gets the same schedule."""
+        if attempt < 1:
+            raise ValueError("attempt is 1-based")
+        base = min(self.max_delay, self.base_delay * (2 ** (attempt - 1)))
+        digest = hashlib.sha256(
+            f"{self.seed}:{key}:{attempt}".encode()
+        ).digest()
+        frac = int.from_bytes(digest[:8], "big") / float(1 << 64)
+        return base * (1.0 + self.jitter * frac)
+
+    def view(self) -> Dict[str, Any]:
+        return {
+            "max_attempts": self.max_attempts,
+            "base_delay": self.base_delay,
+            "max_delay": self.max_delay,
+            "jitter": self.jitter,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-key closed/open/half-open breaker with an injectable clock."""
+
+    def __init__(self, *, threshold: int = 5, cooldown: float = 30.0,
+                 clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> {"state", "failures", "opened_at", "trial"}
+        self._keys: Dict[str, Dict[str, Any]] = {}
+
+    def _entry(self, key: str) -> Dict[str, Any]:
+        return self._keys.setdefault(
+            key, {"state": "closed", "failures": 0, "opened_at": 0.0,
+                  "trial": False}
+        )
+
+    def allow(self, key: str) -> bool:
+        """May a request for `key` proceed right now? An open key admits
+        exactly ONE trial request once the cooldown elapses (half-open);
+        further requests fast-fail until that trial reports back."""
+        with self._lock:
+            e = self._entry(key)
+            if e["state"] == "closed":
+                return True
+            if e["state"] == "open":
+                if self._clock() - e["opened_at"] < self.cooldown:
+                    return False
+                e["state"] = "half-open"
+                e["trial"] = True
+                return True
+            # half-open: only the single in-flight trial is admitted.
+            if e["trial"]:
+                return False
+            e["trial"] = True
+            return True
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            e = self._entry(key)
+            e.update(state="closed", failures=0, trial=False)
+
+    def record_failure(self, key: str) -> None:
+        with self._lock:
+            e = self._entry(key)
+            e["failures"] += 1
+            e["trial"] = False
+            if e["state"] == "half-open" or e["failures"] >= self.threshold:
+                e["state"] = "open"
+                e["opened_at"] = self._clock()
+
+    def state(self, key: str) -> str:
+        with self._lock:
+            return self._keys.get(key, {"state": "closed"})["state"]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "cooldown": self.cooldown,
+                "open_keys": sorted(
+                    k for k, e in self._keys.items() if e["state"] != "closed"
+                ),
+                "states": {k: e["state"] for k, e in self._keys.items()},
+            }
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead job journal
+# ---------------------------------------------------------------------------
+
+
+class JobJournal:
+    """Append-only JSONL WAL for job lifecycle; fsync on every append."""
+
+    def __init__(self, path: str, metrics=None):
+        self.path = path
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    # -- appends -------------------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        rec = dict(rec)
+        rec["t"] = time.time()
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        if self._metrics is not None:
+            self._metrics.inc("journal_records")
+            self._metrics.inc("journal_bytes", len(line))
+
+    def submit(self, job_fields: Dict[str, Any]) -> None:
+        self._append({"rec": "submit", "job": job_fields})
+
+    def start(self, job_id: str, attempt: int) -> None:
+        self._append({"rec": "start", "job_id": job_id, "attempt": attempt})
+
+    def result(self, job_id: str, status: str,
+               error: Optional[str] = None) -> None:
+        rec = {"rec": "result", "job_id": job_id, "status": status}
+        if error is not None:
+            rec["error"] = error
+        self._append(rec)
+
+    def cancel(self, job_id: str) -> None:
+        self._append({"rec": "cancel", "job_id": job_id})
+
+    def retry(self, job_id: str) -> None:
+        self._append({"rec": "retry", "job_id": job_id})
+
+    # -- replay / compaction -------------------------------------------------
+
+    @staticmethod
+    def replay(path: str) -> Dict[str, Dict[str, Any]]:
+        """Fold the log into ``{job_id: {"job", "status", "attempts",
+        "error"}}`` in submission order. Tolerates a truncated final
+        line (kill mid-append) and records for unknown ids (compacted
+        prefix lost); every complete prefix folds consistently."""
+        folded: Dict[str, Dict[str, Any]] = {}
+        if not os.path.exists(path):
+            return folded
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a kill mid-append
+                kind = rec.get("rec")
+                if kind == "submit":
+                    job = rec.get("job") or {}
+                    jid = job.get("id")
+                    if jid:
+                        folded[jid] = {
+                            "job": job, "status": "queued",
+                            "attempts": 0, "error": None,
+                        }
+                    continue
+                entry = folded.get(rec.get("job_id"))
+                if entry is None:
+                    continue
+                if kind == "start":
+                    entry["status"] = "running"
+                    entry["attempts"] = int(
+                        rec.get("attempt", entry["attempts"] + 1)
+                    )
+                elif kind == "result":
+                    entry["status"] = rec.get("status", "done")
+                    entry["error"] = rec.get("error")
+                elif kind == "cancel":
+                    entry["status"] = "cancelled"
+                elif kind == "retry":
+                    entry["status"] = "queued"
+                    entry["error"] = None
+        return folded
+
+    def compact(self, folded: Dict[str, Dict[str, Any]]) -> None:
+        """Atomically rewrite the log as one folded snapshot: a submit
+        record per job plus its terminal/attempt records. Bounds the log
+        after replay and after result GC drops old jobs."""
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "w", encoding="utf-8") as out:
+                for jid, entry in folded.items():
+                    now = time.time()
+                    out.write(json.dumps(
+                        {"rec": "submit", "t": now, "job": entry["job"]},
+                        separators=(",", ":"),
+                    ) + "\n")
+                    status = entry["status"]
+                    if entry["attempts"]:
+                        out.write(json.dumps(
+                            {"rec": "start", "t": now, "job_id": jid,
+                             "attempt": entry["attempts"]},
+                            separators=(",", ":"),
+                        ) + "\n")
+                    if status in ("done", "failed"):
+                        rec = {"rec": "result", "t": now, "job_id": jid,
+                               "status": status}
+                        if entry.get("error"):
+                            rec["error"] = entry["error"]
+                        out.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                    elif status == "cancelled":
+                        out.write(json.dumps(
+                            {"rec": "cancel", "t": now, "job_id": jid},
+                            separators=(",", ":"),
+                        ) + "\n")
+                    elif status == "queued" and entry["attempts"]:
+                        out.write(json.dumps(
+                            {"rec": "retry", "t": now, "job_id": jid},
+                            separators=(",", ":"),
+                        ) + "\n")
+                out.flush()
+                os.fsync(out.fileno())
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        if self._metrics is not None:
+            self._metrics.inc("journal_compactions")
+
+    def stats(self) -> Dict[str, Any]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        return {"path": self.path, "bytes": size}
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Result store
+# ---------------------------------------------------------------------------
+
+
+class ResultStore:
+    """Finished result payloads on disk, one JSON per job, TTL-expired."""
+
+    def __init__(self, root: str, *, ttl: float = 7 * 24 * 3600.0,
+                 clock=time.time, metrics=None):
+        if ttl <= 0:
+            raise ValueError("result ttl must be positive (seconds)")
+        self.root = root
+        self.ttl = ttl
+        self._clock = clock
+        self._metrics = metrics
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, job_id: str) -> str:
+        return os.path.join(self.root, f"{job_id}.json")
+
+    def put(self, job_id: str, payload: Dict[str, Any]) -> None:
+        doc = {"saved_at": self._clock(), "result": payload}
+        path = self._path(job_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        if self._metrics is not None:
+            self._metrics.inc("serve_results_persisted")
+
+    def get(self, job_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._path(job_id), encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if self._clock() - doc.get("saved_at", 0) > self.ttl:
+            return None
+        return doc.get("result")
+
+    def gc(self) -> List[str]:
+        """Delete expired results; returns the expired job ids (the
+        caller prunes its in-memory jobs + journal to match)."""
+        expired: List[str] = []
+        now = self._clock()
+        for name in os.listdir(self.root):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    saved_at = json.load(fh).get("saved_at", 0)
+            except (OSError, ValueError):
+                saved_at = 0  # unreadable -> treat as ancient
+            if now - saved_at > self.ttl:
+                try:
+                    os.remove(path)
+                except OSError:
+                    continue
+                expired.append(name[: -len(".json")])
+        if expired and self._metrics is not None:
+            self._metrics.inc("serve_results_gc", len(expired))
+        return expired
+
+    def stats(self) -> Dict[str, Any]:
+        try:
+            names = [n for n in os.listdir(self.root) if n.endswith(".json")]
+        except OSError:
+            names = []
+        return {"root": self.root, "results": len(names), "ttl": self.ttl}

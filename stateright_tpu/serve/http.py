@@ -14,9 +14,15 @@ Routes (JSON in/out unless noted):
   ``GET /jobs/{id}/result``       the finished job's results (404
                                   unknown, 409 while queued/running)
   ``POST /jobs/{id}/cancel``      cancel a queued job (409 otherwise)
+  ``POST /jobs/{id}/retry``       admin re-enqueue of a failed or
+                                  cancelled job (409 otherwise; resets
+                                  its attempt budget)
   ``POST /scheduler/pause``       freeze the scheduler (deterministic
   ``POST /scheduler/resume``      batching for tests/CI)
-  ``GET /stats``                  queue/cache/quota summary
+  ``GET /stats``                  queue/cache/quota summary plus the
+                                  durability sections: retry policy,
+                                  circuit-breaker states, journal and
+                                  result-store footprints
   ``GET /metrics``                service telemetry snapshot (JSON)
   ``GET /metrics.prom``           Prometheus text exposition with the
                                   per-tenant request series labeled
@@ -123,6 +129,13 @@ class ServeServer:
                     and parts[2] == "cancel"
                 ):
                     code, body = svc.cancel(parts[1])
+                    self._send_json(body, code)
+                elif (
+                    len(parts) == 3
+                    and parts[0] == "jobs"
+                    and parts[2] == "retry"
+                ):
+                    code, body = svc.retry_job(parts[1])
                     self._send_json(body, code)
                 elif path == "/scheduler/pause":
                     svc.pause()

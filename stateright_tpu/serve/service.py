@@ -26,6 +26,16 @@ HTTP surface is serve/http.py). A submission names a bundled model spec
               reuses the warm executable outright.
   results     state counts, per-property discovery paths with
               `Path.explain` forensics, telemetry, and coverage.
+  durability  with `journal_path=`, every lifecycle transition is
+              write-ahead journalled (serve/durability.py) so a
+              restarted service re-enqueues queued jobs, retries jobs
+              that were mid-flight, and keeps serving finished results
+              (persisted per-job under `results_dir=`, TTL-expired).
+              Transient failures (table/probe exhaustion, OOM, worker
+              crashes) retry with bounded exponential backoff —
+              multiplex-lane capacity failures escalate to the solo
+              engine — behind a per-signature circuit breaker; dead
+              worker threads are detected and replaced.
 
 Every stage exports `serve_*` metrics (obs/metrics.py catalog) with
 per-tenant request counts as a labeled Prometheus series.
@@ -44,6 +54,13 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..engines.compiled import ExecutableCache, model_signature
 from ..obs.metrics import MetricsRegistry
 from ..tensor import TensorModel, TensorModelAdapter
+from .durability import (
+    CircuitBreaker,
+    JobJournal,
+    ResultStore,
+    RetryPolicy,
+    classify_failure,
+)
 
 __all__ = ["Job", "RunService"]
 
@@ -56,7 +73,7 @@ class Job:
     __slots__ = (
         "id", "tenant", "spec", "engine", "priority", "status",
         "submitted_at", "started_at", "finished_at", "error", "result",
-        "signature", "model", "options",
+        "signature", "model", "options", "attempts",
     )
 
     def __init__(self, tenant: str, spec: str, engine: str, priority: int,
@@ -76,6 +93,33 @@ class Job:
         self.signature = signature
         self.model = model
         self.options = options
+        self.attempts = 0
+
+    def journal_fields(self) -> Dict[str, Any]:
+        """The job's identity as the write-ahead journal records it —
+        everything needed to reconstruct it after a restart (the model
+        object itself re-resolves from `spec`)."""
+        return {
+            "id": self.id,
+            "tenant": self.tenant,
+            "spec": self.spec,
+            "engine": self.engine,
+            "priority": self.priority,
+            "options": self.options,
+            "submitted_at": self.submitted_at,
+        }
+
+    @classmethod
+    def restore(cls, fields: Dict[str, Any], model: Any,
+                signature: Optional[str]) -> "Job":
+        job = cls(
+            str(fields.get("tenant") or "default"), fields["spec"],
+            fields.get("engine") or "auto", int(fields.get("priority", 0)),
+            model, signature, dict(fields.get("options") or {}),
+        )
+        job.id = fields["id"]
+        job.submitted_at = fields.get("submitted_at", job.submitted_at)
+        return job
 
     def view(self) -> Dict[str, Any]:
         out = {
@@ -88,6 +132,7 @@ class Job:
             "submitted_at": self.submitted_at,
             "started_at": self.started_at,
             "finished_at": self.finished_at,
+            "attempts": self.attempts,
         }
         if self.error is not None:
             out["error"] = self.error
@@ -125,6 +170,12 @@ class RunService:
         quota_max_active: int = 256,
         quota_per_minute: int = 600,
         lint_samples: int = 64,
+        journal_path: Optional[str] = None,
+        results_dir: Optional[str] = None,
+        result_ttl: float = 7 * 24 * 3600.0,
+        retry: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        guard_interval: float = 0.5,
     ):
         self.lanes = lanes
         self.lane_options = {
@@ -152,12 +203,33 @@ class RunService:
         self._lint_cache: Dict[str, Any] = {}
         self._paused = False
         self._stop = False
+
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._journal = (
+            JobJournal(journal_path, metrics=self.metrics)
+            if journal_path else None
+        )
+        self._results = (
+            ResultStore(results_dir, ttl=result_ttl, metrics=self.metrics)
+            if results_dir else None
+        )
+        self._timers: set = set()
+        self._guard_interval = guard_interval
+
+        # Replay the write-ahead journal BEFORE any worker can pop: a
+        # restarted service re-enqueues queued jobs, retries jobs that
+        # were mid-flight at the kill, and serves persisted results.
         self._workers = [
             threading.Thread(target=self._worker, daemon=True)
             for _ in range(max(1, workers))
         ]
+        if self._journal is not None:
+            self._replay_journal()
         for t in self._workers:
             t.start()
+        self._guard = threading.Thread(target=self._guard_loop, daemon=True)
+        self._guard.start()
 
     # -- scheduler control ---------------------------------------------------
 
@@ -176,8 +248,122 @@ class RunService:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+            timers = list(self._timers)
+        for timer in timers:
+            timer.cancel()
         for t in self._workers:
             t.join(timeout=5)
+        if self._journal is not None:
+            self._journal.close()
+
+    # -- durability ----------------------------------------------------------
+
+    def _replay_journal(self) -> None:
+        """Fold the journal back into the job table: queued jobs
+        re-enqueue, interrupted (running-at-kill) jobs re-enqueue as a
+        retry, done jobs reload their persisted result, terminal jobs
+        keep their status. Runs before the workers start."""
+        folded = JobJournal.replay(self._journal.path)
+        for jid, entry in folded.items():
+            fields = entry["job"]
+            status = entry["status"]
+            model = None
+            signature = None
+            resolve_error: Optional[str] = None
+            if status in ("queued", "running"):
+                try:
+                    model = _resolve_spec(fields["spec"])
+                except ValueError as e:
+                    resolve_error = f"unresolvable after restart: {e}"
+                if model is not None and isinstance(
+                    model, (TensorModel, TensorModelAdapter)
+                ):
+                    signature = model_signature(model)
+            job = Job.restore(fields, model, signature)
+            job.attempts = entry["attempts"]
+            self._jobs[job.id] = job
+            self.metrics.inc("journal_replayed_jobs")
+            if status == "done":
+                job.status = "done"
+                job.finished_at = job.submitted_at
+                if self._results is not None:
+                    job.result = self._results.get(job.id)
+                self.metrics.inc("journal_recovered_done")
+            elif status == "failed":
+                job.status = "failed"
+                job.error = entry.get("error")
+                job.finished_at = job.submitted_at
+            elif status == "cancelled":
+                job.status = "cancelled"
+                job.finished_at = job.submitted_at
+            elif resolve_error is not None:
+                job.status = "failed"
+                job.error = resolve_error
+                job.finished_at = time.time()
+            else:
+                job.status = "queued"
+                heapq.heappush(
+                    self._heap, (-job.priority, next(self._seq), job)
+                )
+                self.metrics.inc(
+                    "journal_recovered_running" if status == "running"
+                    else "journal_recovered_queued"
+                )
+        self._update_gauges_locked()
+        self._journal.compact(self._folded_state())
+
+    def _folded_state(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            j.id: {
+                "job": j.journal_fields(),
+                "status": j.status,
+                "attempts": j.attempts,
+                "error": j.error,
+            }
+            for j in self._jobs.values()
+        }
+
+    def _guard_loop(self) -> None:
+        """Worker self-healing + periodic result GC. A worker thread
+        that dies OUTSIDE its per-batch try (a crash in the pop path, an
+        interpreter-level error) would otherwise silently shrink the
+        pool until the queue stalls; the guard detects and replaces it."""
+        last_gc = time.monotonic()
+        while True:
+            time.sleep(self._guard_interval)
+            with self._cv:
+                if self._stop:
+                    return
+                for i, t in enumerate(self._workers):
+                    if not t.is_alive():
+                        self.metrics.inc("serve_worker_crashes")
+                        nt = threading.Thread(
+                            target=self._worker, daemon=True
+                        )
+                        self._workers[i] = nt
+                        nt.start()
+            if (
+                self._results is not None
+                and time.monotonic() - last_gc >= 60.0
+            ):
+                last_gc = time.monotonic()
+                self.gc_results()
+
+    def gc_results(self) -> List[str]:
+        """Expire persisted results past their TTL, drop the matching
+        in-memory done jobs, and compact the journal to the survivors."""
+        if self._results is None:
+            return []
+        expired = self._results.gc()
+        with self._cv:
+            for jid in expired:
+                job = self._jobs.get(jid)
+                if job is not None and job.status == "done":
+                    del self._jobs[jid]
+            folded = self._folded_state()
+        if expired and self._journal is not None:
+            self._journal.compact(folded)
+        return expired
 
     # -- admission -----------------------------------------------------------
 
@@ -239,6 +425,11 @@ class RunService:
             heapq.heappush(self._heap, (-priority, next(self._seq), job))
             self._note_submit(tenant)
             self._update_gauges_locked()
+            if self._journal is not None:
+                # Write-ahead: the submit record is durable before the
+                # 202 is acknowledged (and before any worker can log a
+                # start for it — appends order under this lock).
+                self._journal.submit(job.journal_fields())
             self._cv.notify()
         return 202, {"job_id": job.id, "status": "queued"}
 
@@ -310,6 +501,40 @@ class RunService:
             job.finished_at = time.time()
             self.metrics.inc("serve_cancelled")
             self._update_gauges_locked()
+            if self._journal is not None:
+                self._journal.cancel(job.id)
+        return 200, job.view()
+
+    def retry_job(self, job_id: str) -> Tuple[int, Dict[str, Any]]:
+        """Admin re-enqueue of a failed or cancelled job (HTTP
+        ``POST /jobs/{id}/retry``). Resets the attempt budget; a job
+        restored from the journal re-resolves its model first."""
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return 404, {"error": f"no job {job_id!r}"}
+            if job.status not in ("failed", "cancelled"):
+                return 409, {
+                    "error": f"job {job_id} is {job.status}; only "
+                    "failed/cancelled jobs retry"
+                }
+            if job.model is None:
+                try:
+                    job.model = _resolve_spec(job.spec)
+                except ValueError as e:
+                    return 400, {"error": str(e)}
+                if isinstance(job.model, (TensorModel, TensorModelAdapter)):
+                    job.signature = model_signature(job.model)
+            job.status = "queued"
+            job.error = None
+            job.finished_at = None
+            job.attempts = 0
+            self.metrics.inc("serve_admin_retries")
+            heapq.heappush(self._heap, (-job.priority, next(self._seq), job))
+            self._update_gauges_locked()
+            if self._journal is not None:
+                self._journal.retry(job.id)
+            self._cv.notify()
         return 200, job.view()
 
     def stats(self) -> Dict[str, Any]:
@@ -317,7 +542,7 @@ class RunService:
             by_status: Dict[str, int] = {}
             for j in self._jobs.values():
                 by_status[j.status] = by_status.get(j.status, 0) + 1
-            return {
+            out = {
                 "jobs": by_status,
                 "queue_depth": sum(
                     1 for j in self._jobs.values() if j.status == "queued"
@@ -328,7 +553,14 @@ class RunService:
                     "max_active": self.quota_max_active,
                     "per_minute": self.quota_per_minute,
                 },
+                "retry": self.retry.view(),
+                "breaker": self.breaker.snapshot(),
             }
+            if self._journal is not None:
+                out["journal"] = self._journal.stats()
+            if self._results is not None:
+                out["results"] = self._results.stats()
+            return out
 
     def telemetry(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()
@@ -378,6 +610,9 @@ class RunService:
         for j in batch:
             j.status = "running"
             j.started_at = now
+            j.attempts += 1
+            if self._journal is not None:
+                self._journal.start(j.id, j.attempts)
         self._update_gauges_locked()
         return batch
 
@@ -393,13 +628,72 @@ class RunService:
                 batch = self._pop_batch()
             if not batch:
                 continue
+            key = batch[0].signature or batch[0].spec
+            if not self.breaker.allow(key):
+                # Fast-fail while the breaker is open: repeated failures
+                # for this signature must not keep burning device time.
+                self.metrics.inc("serve_breaker_fastfail", len(batch))
+                self._finish(
+                    batch,
+                    error=f"circuit breaker open for {key!r} after repeated "
+                    "failures; retry after the cooldown",
+                )
+                continue
             try:
                 if batch[0].engine == "multiplex":
                     self._run_multiplex_batch(batch)
                 else:
                     self._run_solo(batch[0])
             except Exception as e:
-                self._finish(batch, error=f"{type(e).__name__}: {e}")
+                self.breaker.record_failure(key)
+                self._handle_failure(batch, e)
+            else:
+                self.breaker.record_success(key)
+
+    def _handle_failure(self, jobs: List[Job], exc: Exception) -> None:
+        """Transient failures retry with deterministic backoff (a
+        multiplex capacity failure escalates to the solo engine, which
+        sizes its tables dynamically); everything else — and any job out
+        of attempts — fails for real."""
+        msg = f"{type(exc).__name__}: {exc}"
+        transient, escalate = classify_failure(msg)
+        retriable = [
+            j for j in jobs
+            if transient and j.attempts < self.retry.max_attempts
+        ]
+        exhausted = [j for j in jobs if j not in retriable]
+        if exhausted:
+            if transient:
+                self.metrics.inc("retry_exhausted", len(exhausted))
+            self._finish(exhausted, error=msg)
+        for j in retriable:
+            if escalate and j.engine == "multiplex":
+                j.engine = "tpu_bfs"
+                self.metrics.inc("retry_escalated_solo")
+            delay = self.retry.delay(j.attempts, key=j.id)
+            self.metrics.inc("retry_scheduled")
+            with self._cv:
+                # Queued-but-not-in-heap while backing off: invisible to
+                # the scheduler, still cancellable; the timer re-enqueues.
+                j.status = "queued"
+                j.error = msg  # last error, visible while waiting
+                self._update_gauges_locked()
+                timer = threading.Timer(delay, self._requeue, args=(j,))
+                timer.daemon = True
+                self._timers.add(timer)
+            timer.start()
+
+    def _requeue(self, job: Job) -> None:
+        with self._cv:
+            self._timers = {t for t in self._timers if t.is_alive()}
+            if self._stop or job.status != "queued":
+                return  # cancelled (or service stopping) while backing off
+            job.error = None
+            heapq.heappush(self._heap, (-job.priority, next(self._seq), job))
+            self._update_gauges_locked()
+            if self._journal is not None:
+                self._journal.retry(job.id)
+            self._cv.notify()
 
     def _finish(self, jobs: List[Job], error: Optional[str] = None) -> None:
         now = time.time()
@@ -415,6 +709,18 @@ class RunService:
                     self.metrics.inc("serve_completed")
             self._update_gauges_locked()
             self._cv.notify_all()
+        # Durability, outside the scheduler lock: the result payload
+        # lands on disk BEFORE the journal's terminal record, so replay
+        # never claims "done" without a readable result.
+        for j in jobs:
+            if (
+                error is None
+                and self._results is not None
+                and j.result is not None
+            ):
+                self._results.put(j.id, j.result)
+            if self._journal is not None:
+                self._journal.result(j.id, j.status, error=j.error)
 
     # -- execution -----------------------------------------------------------
 

@@ -70,7 +70,9 @@ def test_periodic_checkpoint_written(tmp_path):
     checker = (
         TensorModelAdapter(TwoPhaseTensor(4))
         .checker()
-        .spawn_tpu_bfs(checkpoint_path=ckpt, checkpoint_every=0.0, **OPTS)
+        # checkpoint_every is wall-clock seconds; a tiny positive cadence
+        # checkpoints at (almost) every era boundary.
+        .spawn_tpu_bfs(checkpoint_path=ckpt, checkpoint_every=1e-4, **OPTS)
         .join()
     )
     full = checker.unique_state_count()
@@ -82,3 +84,84 @@ def test_periodic_checkpoint_written(tmp_path):
         .join()
     )
     assert resumed.unique_state_count() == full
+    tel = checker.telemetry()
+    assert tel.get("checkpoint_saves", 0) >= 1
+    assert tel.get("checkpoint_bytes", 0) > 0
+
+
+def test_checkpoint_every_must_be_positive(tmp_path):
+    """checkpoint_every is wall-clock SECONDS; non-positive values are a
+    configuration error at builder time, not "checkpoint constantly"."""
+    import pytest
+
+    ckpt = str(tmp_path / "bad.ckpt.npz")
+    builder = TensorModelAdapter(TwoPhaseTensor(3)).checker()
+    for bad in (0, 0.0, -1.0):
+        with pytest.raises(ValueError, match="wall-clock seconds"):
+            builder.spawn_tpu_bfs(
+                checkpoint_path=ckpt, checkpoint_every=bad, **OPTS
+            )
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        builder.spawn_tpu_bfs(checkpoint_every=1.0, **OPTS)
+    with pytest.raises(ValueError, match="keep_checkpoints"):
+        builder.spawn_tpu_bfs(checkpoint_path=ckpt, keep_checkpoints=0, **OPTS)
+
+
+def test_corrupt_checkpoint_falls_back_to_previous_generation(tmp_path):
+    """Truncating the newest checkpoint must not lose the run: the loader
+    rejects it on its content digest and resumes from the previous rolling
+    generation (keep_checkpoints), still landing on the exact golden."""
+    import os
+
+    ckpt = str(tmp_path / "gen.ckpt.npz")
+    (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .target_state_count(2_000)
+        .spawn_tpu_bfs(
+            checkpoint_path=ckpt, checkpoint_every=1e-4,
+            keep_checkpoints=3, **OPTS
+        )
+        .join()
+    )
+    assert os.path.exists(ckpt) and os.path.exists(ckpt + ".1")
+    # Truncate the newest generation mid-file — a classic kill-mid-write.
+    size = os.path.getsize(ckpt)
+    with open(ckpt, "r+b") as f:
+        f.truncate(size // 2)
+    resumed = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_tpu_bfs(resume_from=ckpt, **OPTS)
+        .join()
+    )
+    assert resumed.unique_state_count() == 8832
+    assert resumed.telemetry().get("checkpoint_fallbacks", 0) == 1
+    assert resumed.telemetry().get("checkpoint_corrupt_rejected", 0) == 1
+
+
+def test_corrupt_only_checkpoint_rejected_loudly(tmp_path):
+    """With every generation corrupt, resume must fail with a clear
+    CheckpointCorruptError instead of resuming from garbage."""
+    import pytest
+
+    from stateright_tpu.engines.common import CheckpointCorruptError
+
+    ckpt = str(tmp_path / "solo.ckpt.npz")
+    (
+        TensorModelAdapter(TwoPhaseTensor(4))
+        .checker()
+        .spawn_tpu_bfs(checkpoint_path=ckpt, keep_checkpoints=1, **OPTS)
+        .join()
+    )
+    # Flip bytes in the zip payload: digest verification must catch it.
+    with open(ckpt, "r+b") as f:
+        f.seek(200)
+        f.write(b"\xde\xad\xbe\xef" * 8)
+    with pytest.raises(CheckpointCorruptError, match="corrupt|digest"):
+        (
+            TensorModelAdapter(TwoPhaseTensor(4))
+            .checker()
+            .spawn_tpu_bfs(resume_from=ckpt, **OPTS)
+            .join()
+        )

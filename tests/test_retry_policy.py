@@ -1,0 +1,245 @@
+"""Pure-unit tests for the serve durability primitives
+(serve/durability.py): retry backoff determinism, failure
+classification, circuit-breaker state machine, journal fold/compaction,
+and result-store TTL — no engines, no threads, no service."""
+
+import json
+import os
+
+import pytest
+
+from stateright_tpu.serve.durability import (
+    CircuitBreaker,
+    JobJournal,
+    ResultStore,
+    RetryPolicy,
+    classify_failure,
+)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_is_exponential_and_capped():
+    p = RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.0)
+    assert p.delay(1) == pytest.approx(0.1)
+    assert p.delay(2) == pytest.approx(0.2)
+    assert p.delay(3) == pytest.approx(0.4)
+    assert p.delay(8) == pytest.approx(1.0)  # capped at max_delay
+
+
+def test_jitter_is_deterministic_per_seed_and_key():
+    a = RetryPolicy(seed=7, jitter=0.5)
+    b = RetryPolicy(seed=7, jitter=0.5)
+    c = RetryPolicy(seed=8, jitter=0.5)
+    assert a.delay(2, key="job-1") == b.delay(2, key="job-1")
+    assert a.delay(2, key="job-1") != a.delay(2, key="job-2")
+    assert a.delay(2, key="job-1") != c.delay(2, key="job-1")
+    base = RetryPolicy(jitter=0.0).delay(2)
+    d = a.delay(2, key="job-1")
+    assert base <= d <= base * 1.5  # jitter fraction in [0, 0.5]
+
+
+def test_policy_validates_configuration():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="base_delay"):
+        RetryPolicy(base_delay=0)
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=2.0)
+    with pytest.raises(ValueError, match="1-based"):
+        RetryPolicy().delay(0)
+
+
+def test_classify_failure():
+    transient, escalate = classify_failure(
+        "RuntimeError: lane 3 did not complete within the lane budget "
+        "(frontier=9, unique=70000); raise queue_capacity/table_capacity "
+        "or run it solo via spawn_tpu_bfs"
+    )
+    assert transient and escalate
+    transient, escalate = classify_failure(
+        "RuntimeError: visited-table probe budget exhausted despite headroom"
+    )
+    assert transient and escalate
+    transient, escalate = classify_failure(
+        "XlaRuntimeError: RESOURCE_EXHAUSTED: out of memory allocating ..."
+    )
+    assert transient and not escalate
+    transient, escalate = classify_failure(
+        "ValueError: unknown model spec 'nope:1'"
+    )
+    assert not transient and not escalate
+    assert classify_failure("AssertionError: model bug") == (False, False)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker (with a fake clock: fully deterministic)
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_threshold_and_cools_down():
+    clock = _Clock()
+    br = CircuitBreaker(threshold=3, cooldown=10.0, clock=clock)
+    for _ in range(2):
+        br.record_failure("sig")
+        assert br.allow("sig")  # still closed below threshold
+    br.record_failure("sig")
+    assert br.state("sig") == "open"
+    assert not br.allow("sig")  # fast-fail during cooldown
+    clock.t = 9.9
+    assert not br.allow("sig")
+    clock.t = 10.0
+    assert br.allow("sig")  # ONE half-open trial admitted
+    assert br.state("sig") == "half-open"
+    assert not br.allow("sig")  # ...and only one
+
+
+def test_breaker_half_open_success_closes_failure_reopens():
+    clock = _Clock()
+    br = CircuitBreaker(threshold=1, cooldown=5.0, clock=clock)
+    br.record_failure("sig")
+    clock.t = 5.0
+    assert br.allow("sig")
+    br.record_success("sig")
+    assert br.state("sig") == "closed"
+    assert br.allow("sig")
+
+    br.record_failure("sig")  # open again (threshold=1)
+    clock.t = 10.0
+    assert br.allow("sig")  # trial
+    br.record_failure("sig")  # trial failed -> re-open immediately
+    assert br.state("sig") == "open"
+    assert not br.allow("sig")
+
+
+def test_breaker_keys_are_independent():
+    br = CircuitBreaker(threshold=1, cooldown=100.0, clock=_Clock())
+    br.record_failure("bad-sig")
+    assert not br.allow("bad-sig")
+    assert br.allow("good-sig")
+    assert br.snapshot()["open_keys"] == ["bad-sig"]
+
+
+# ---------------------------------------------------------------------------
+# JobJournal: fold rules, torn-tail tolerance, compaction
+# ---------------------------------------------------------------------------
+
+
+def _fields(jid, **over):
+    f = {"id": jid, "tenant": "t", "spec": "increment:2", "engine": "bfs",
+         "priority": 0, "options": {}, "submitted_at": 1.0}
+    f.update(over)
+    return f
+
+
+def test_journal_folds_lifecycle(tmp_path):
+    path = str(tmp_path / "jobs.jsonl")
+    j = JobJournal(path)
+    j.submit(_fields("aaa"))
+    j.submit(_fields("bbb"))
+    j.submit(_fields("ccc"))
+    j.submit(_fields("ddd"))
+    j.start("aaa", 1)
+    j.result("aaa", "done")
+    j.start("bbb", 1)  # interrupted: no result record follows
+    j.cancel("ccc")
+    j.start("ddd", 1)
+    j.result("ddd", "failed", error="boom")
+    j.retry("ddd")
+    j.close()
+
+    folded = JobJournal.replay(path)
+    assert folded["aaa"]["status"] == "done"
+    assert folded["bbb"]["status"] == "running"
+    assert folded["bbb"]["attempts"] == 1
+    assert folded["ccc"]["status"] == "cancelled"
+    assert folded["ddd"]["status"] == "queued"  # retried after failure
+    assert folded["ddd"]["error"] is None
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    j = JobJournal(path)
+    j.submit(_fields("aaa"))
+    j.result("aaa", "done")
+    j.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"rec": "submit", "job": {"id": "bb')  # kill mid-append
+    folded = JobJournal.replay(path)
+    assert list(folded) == ["aaa"]
+    assert folded["aaa"]["status"] == "done"
+
+
+def test_journal_compaction_preserves_fold_and_shrinks(tmp_path):
+    path = str(tmp_path / "compact.jsonl")
+    j = JobJournal(path)
+    j.submit(_fields("aaa"))
+    for attempt in range(1, 20):
+        j.start("aaa", attempt)
+        j.retry("aaa")
+    j.start("aaa", 20)
+    j.result("aaa", "done")
+    j.submit(_fields("bbb"))
+    before = os.path.getsize(path)
+    folded = JobJournal.replay(path)
+    j.compact(folded)
+    assert os.path.getsize(path) < before
+    assert JobJournal.replay(path) == folded
+    # The journal stays appendable after compaction swapped the file.
+    j.submit(_fields("ccc"))
+    j.close()
+    assert "ccc" in JobJournal.replay(path)
+
+
+def test_journal_ignores_records_for_unknown_jobs(tmp_path):
+    path = str(tmp_path / "unknown.jsonl")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"rec": "result", "job_id": "ghost",
+                             "status": "done"}) + "\n")
+    assert JobJournal.replay(path) == {}
+
+
+# ---------------------------------------------------------------------------
+# ResultStore: persistence + TTL GC
+# ---------------------------------------------------------------------------
+
+
+def test_result_store_roundtrip_and_ttl(tmp_path):
+    clock = _Clock()
+    store = ResultStore(str(tmp_path / "results"), ttl=100.0, clock=clock)
+    store.put("aaa", {"unique_state_count": 13})
+    assert store.get("aaa") == {"unique_state_count": 13}
+    clock.t = 99.0
+    assert store.get("aaa") is not None
+    clock.t = 101.0
+    assert store.get("aaa") is None  # expired reads return nothing
+    assert store.gc() == ["aaa"]  # ...and GC removes the file
+    assert store.stats()["results"] == 0
+    assert store.gc() == []
+
+
+def test_result_store_gc_only_expires_old_entries(tmp_path):
+    clock = _Clock()
+    store = ResultStore(str(tmp_path / "r"), ttl=50.0, clock=clock)
+    store.put("old", {"n": 1})
+    clock.t = 40.0
+    store.put("new", {"n": 2})
+    clock.t = 60.0
+    assert store.gc() == ["old"]
+    assert store.get("new") == {"n": 2}
+
+
+def test_result_store_rejects_bad_ttl(tmp_path):
+    with pytest.raises(ValueError, match="ttl"):
+        ResultStore(str(tmp_path / "x"), ttl=0)
