@@ -519,6 +519,32 @@ def main() -> None:
         "overhead_pct": round((1.0 - dev_rate / rate_off) * 100.0, 2),
     }
 
+    # Span cost: the same workload with a span recorder attached — the
+    # run ledger's engine tier (obs/spans.py: one run span, a progress
+    # span per era, phase spans at seal). Both rates land in BENCH json
+    # (acceptance: enabling spans costs < 2% — recording is a dict
+    # append per era, far off the device hot path).
+    from stateright_tpu.obs.spans import SpanRecorder as _SpanRecorder
+
+    TensorModelAdapter(tm7).checker().spans(_SpanRecorder()).spawn_tpu_bfs(
+        **opts
+    ).join()  # compile
+    med7sp, _spread7sp, dev7sp = timed3(
+        lambda: (
+            TensorModelAdapter(tm7).checker().spans(_SpanRecorder())
+            .spawn_tpu_bfs(**opts)
+        ),
+        golden=tpc7_golden,
+    )
+    rate_sp = dev7sp.state_count() / med7sp
+    span_overhead_pct = (1.0 - rate_sp / dev_rate) * 100.0
+    detail["tpc7_span_cost"] = {
+        "states_per_sec_spans_on": round(rate_sp, 1),
+        "states_per_sec_spans_off": round(dev_rate, 1),
+        "overhead_pct": round(span_overhead_pct, 2),
+    }
+    assert span_overhead_pct < 2.0, detail["tpc7_span_cost"]
+
     # Checkpoint cost: the same workload writing periodic crash-safe
     # checkpoints (atomic tmp+fsync+rename at era boundaries) vs the
     # plain run above. Both rates land in BENCH json (acceptance:
@@ -1013,13 +1039,19 @@ def main() -> None:
             for job_id in ids:
                 result = req("GET", f"/jobs/{job_id}/result")["result"]
                 assert result["unique_state_count"] == 13, result
-            cache = req("GET", "/stats")["cache"]
+            stats = req("GET", "/stats")
+            cache = stats["cache"]
             # One shape, one executable: the whole batch compiled ONCE.
             assert cache["misses"] == 1, cache
         finally:
             server.shutdown()
         mux_rate = n_checks / mux_secs
         speedup = mux_rate / serial_rate
+        # Submit->result latency distribution (obs/metrics.py Histogram
+        # behind /stats "latency"): the whole batch rode one fused era,
+        # so even the p99 must land within the bench's own wall-clock.
+        latency = stats.get("latency") or {}
+        s2r = latency.get("submit_to_result") or {}
         detail["service"] = {
             "concurrent_checks": n_checks,
             "multiplexed_checks_per_sec": round(mux_rate, 2),
@@ -1029,9 +1061,12 @@ def main() -> None:
             "cache_hit_rate": round(
                 cache["hits"] / max(1, cache["hits"] + cache["misses"]), 3
             ),
+            "latency": latency,
             "golden_match": True,
         }
         assert speedup >= 5.0, detail["service"]
+        assert s2r.get("count", 0) >= n_checks, latency
+        assert 0.0 < s2r.get("p99", 0.0) < 60.0, latency
 
     def _sec_service_durable():
         # --- serve durability cost: the same 32-check REST batch with the

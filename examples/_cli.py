@@ -71,6 +71,14 @@ def example_main(
     subcommand = argv[0] if argv else "check"
     rest = argv[1:]
 
+    # Every subcommand honors `--log-level LEVEL` (the structured logger
+    # in stateright_tpu/obs/log.py; default $STATERIGHT_LOG or warning).
+    log_level = _pop_flag(rest, "--log-level")
+    if log_level:
+        from stateright_tpu.obs.log import configure
+
+        configure(level=log_level)
+
     def arg(i, default):
         return rest[i] if len(rest) > i else default
 

@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Seven stages, fail-fast:
+# Eight stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -22,7 +22,11 @@
 #      mid-flight, resumed from its crash-safe checkpoint to the exact
 #      golden, and a journaled run service is killed with queued jobs and
 #      restarted — every job must recover and finish,
-#   7. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   7. an observability smoke: one submitted job must yield span events
+#      over the /events SSE stream, histogram _bucket series in
+#      /metrics.prom, and a Chrome-trace export that JSON-parses with
+#      matching B/E pairs,
+#   8. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -203,6 +207,79 @@ for i in ids:
     assert job.result["unique_state_count"] == 13, job.result
 svc.shutdown()
 print("durability smoke OK: checkpoint resumed to 8832; 3 jobs recovered")
+PY
+
+echo "== observability smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+from stateright_tpu.serve import RunService, ServeServer
+
+service = RunService(workers=1, lanes=8, lint_samples=32)
+server = ServeServer(service, "127.0.0.1:0").serve_in_background()
+base = server.url.rstrip("/")
+
+
+def req(method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method)
+    with urllib.request.urlopen(r) as resp:
+        return json.loads(resp.read())
+
+
+body = req("POST", "/submit", {"spec": "increment:2"})
+job_id, trace_id = body["job_id"], body["trace_id"]
+while req("GET", f"/jobs/{job_id}")["status"] in ("queued", "running"):
+    time.sleep(0.2)
+assert req("GET", f"/jobs/{job_id}")["status"] == "done"
+
+# The /events SSE stream must yield span events (replay seeds the
+# already-finished job's ledger; limit+duration bound the read).
+raw = urllib.request.urlopen(
+    f"{base}/events?replay=50&limit=5&duration=5"
+).read().decode()
+span_events = [
+    json.loads(blk.split("data: ", 1)[1])
+    for blk in raw.split("\n\n")
+    if blk.startswith("event: span")
+]
+assert span_events, raw[:400]
+names = {s["name"] for s in span_events}
+assert names & {"job", "admission", "queue_wait", "execute"}, names
+
+# The job's full ledger hangs off /jobs/{id}/trace in submit order.
+ledger = req("GET", f"/jobs/{job_id}/trace")
+assert ledger["trace_id"] == trace_id
+lnames = [s["name"] for s in ledger["spans"]]
+for expected in ("admission", "queue_wait", "execute", "job"):
+    assert expected in lnames, lnames
+
+# Prometheus exposition must carry the latency histogram series.
+prom = urllib.request.urlopen(f"{base}/metrics.prom").read().decode()
+assert "_bucket{le=" in prom, prom[:400]
+assert "submit_to_result_secs_count" in prom, prom[:400]
+
+# The exported Chrome trace must JSON-parse with matching B/E pairs.
+from stateright_tpu.obs.spans import spans_to_chrome
+
+out = os.path.join(tempfile.mkdtemp(prefix="_obs_smoke."), "trace.json")
+service.spans.export_chrome(out)
+with open(out) as fh:
+    events = json.load(fh)
+begins = sum(1 for e in events if e.get("ph") == "B")
+ends = sum(1 for e in events if e.get("ph") == "E")
+assert begins and begins == ends, (begins, ends)
+assert begins == len(spans_to_chrome(service.spans.spans())) // 2
+
+server.shutdown()
+print(
+    f"observability smoke OK: {len(span_events)} SSE spans, "
+    f"{len(ledger['spans'])}-span job ledger, {begins} B/E pairs"
+)
 PY
 
 echo "== tier-1 tests =="

@@ -57,6 +57,9 @@ class CheckerBuilder:
         self.strict_samples_: int = 128
         self.lint_report_: Optional[Any] = None
         self.multiplex_lane_: bool = False
+        self.span_recorder_: Optional[Any] = None
+        self.span_trace_id_: Optional[str] = None
+        self.span_parent_id_: Optional[str] = None
 
     # -- options ------------------------------------------------------------
 
@@ -147,6 +150,27 @@ class CheckerBuilder:
         """Bracket the run with `jax.profiler` start/stop_trace into
         `log_dir`. A no-op when the profiler is unavailable."""
         self.profile_dir_ = log_dir
+        return self
+
+    def spans(
+        self,
+        recorder: Any,
+        trace_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
+    ) -> "CheckerBuilder":
+        """Record this run into a `SpanRecorder` (obs/spans.py): one
+        ``run`` span for the whole check plus one ``phase:<name>`` child
+        per phase timer at run end — the run ledger's engine tier.
+        `trace_id` / `parent_id` link the run into an enclosing trace
+        (the serve layer passes the job's ids so engine time nests under
+        the job's ``execute`` span); omitted, the run starts its own
+        trace. With `trace(path, format="chrome")` also set, the
+        recorder's spans are embedded into the Chrome trace at close, so
+        one Perfetto file shows phases and request spans on aligned
+        clocks."""
+        self.span_recorder_ = recorder
+        self.span_trace_id_ = trace_id
+        self.span_parent_id_ = parent_id
         return self
 
     def stage_profile(self, enable: bool = True, iters: int = 32) -> "CheckerBuilder":

@@ -17,10 +17,13 @@ from typing import Any, Dict, Optional
 from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
 from ..obs.coverage import Coverage
+from ..obs.log import get_logger
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import make_trace_writer, start_profile, stop_profile
 
 BLOCK_SIZE = 1500  # states per finish_when re-check; reference bfs.rs:130
+
+_log = get_logger("engines.common")
 
 
 class HostEngineBase(Checker):
@@ -89,6 +92,25 @@ class HostEngineBase(Checker):
             else None
         )
         self._profile_dir: Optional[str] = getattr(builder, "profile_dir_", None)
+        # Span ledger (obs/spans.py) via CheckerBuilder.spans(): the whole
+        # run becomes one "run" span with phase-timer children; the run
+        # span's id is pre-assigned so per-era progress spans can parent to
+        # it before it is sealed in _run_guarded's finally.
+        self._spans = getattr(builder, "span_recorder_", None)
+        if self._spans is not None:
+            from ..obs.spans import new_span_id, new_trace_id
+
+            self._span_trace_id = (
+                getattr(builder, "span_trace_id_", None) or new_trace_id()
+            )
+            self._span_parent_id = getattr(builder, "span_parent_id_", None)
+            self._span_run_id = new_span_id()
+        else:
+            self._span_trace_id = None
+            self._span_parent_id = None
+            self._span_run_id = None
+        self._span_run_start: Optional[float] = None
+        self._span_last_event: Optional[float] = None
         self._last_phase_ms: Dict[str, float] = {}
         self._done = threading.Event()
         # Graceful-stop request (SIGTERM/SIGINT flush, see
@@ -139,6 +161,9 @@ class HostEngineBase(Checker):
         profiling = (
             start_profile(self._profile_dir) if self._profile_dir else False
         )
+        if self._spans is not None:
+            self._span_run_start = time.time()
+            self._span_last_event = self._span_run_start
         if self._trace is not None:
             self._trace.emit(
                 "run_start",
@@ -161,8 +186,47 @@ class HostEngineBase(Checker):
                     phase_ms=self._metrics.phase_ms(),
                     error=repr(self._error) if self._error else None,
                 )
+            if self._spans is not None:
+                self._seal_run_span()
+            if self._trace is not None:
                 self._trace.close()
             self._done.set()
+
+    def _seal_run_span(self) -> None:
+        """Record the run span (pre-assigned id, so per-era children are
+        already parented to it), attach one child span per phase timer,
+        and — when the run also wrote a Chrome trace — embed the ledger
+        into the trace file so phases and request spans share one
+        Perfetto timeline."""
+        from ..obs.spans import attach_phase_spans
+
+        end = time.time()
+        attach_phase_spans(
+            self._spans,
+            self._metrics.phase_ms(),
+            trace_id=self._span_trace_id,
+            parent_id=self._span_run_id,
+            end=end,
+            attributes={"engine": type(self).__name__},
+        )
+        self._spans.record(
+            "run",
+            start=self._span_run_start or end,
+            end=end,
+            trace_id=self._span_trace_id,
+            span_id=self._span_run_id,
+            parent_id=self._span_parent_id,
+            status="error" if self._error else "ok",
+            attributes={
+                "engine": type(self).__name__,
+                "states": int(self._state_count),
+                "unique": int(self.unique_state_count()),
+                "max_depth": int(self._max_depth),
+                **({"error": repr(self._error)} if self._error else {}),
+            },
+        )
+        if self._trace is not None and hasattr(self._trace, "embed_spans"):
+            self._trace.embed_spans(self._spans.spans(self._span_trace_id))
 
     def _run(self) -> None:
         raise NotImplementedError
@@ -250,6 +314,23 @@ class HostEngineBase(Checker):
         m = self._metrics
         m.set_gauge("frontier_size", int(frontier))
         m.set_gauge("max_depth", int(self._max_depth))
+        if self._spans is not None:
+            # One progress span per era/wave/round, spanning the gap since
+            # the previous progress event, under the run span.
+            now = time.time()
+            self._spans.record(
+                event,
+                start=self._span_last_event or now,
+                end=now,
+                trace_id=self._span_trace_id,
+                parent_id=self._span_run_id,
+                attributes={
+                    "states": int(self._state_count),
+                    "unique": int(self.unique_state_count()),
+                    "frontier": int(frontier),
+                },
+            )
+            self._span_last_event = now
         if self._trace is not None:
             if self._coverage.enabled and "coverage" not in extra:
                 # Cumulative per-action fire counts ride every progress
@@ -540,8 +621,6 @@ def load_checkpoint_with_fallback(path: str, metrics=None):
     truncated `path` falls back to `path.1`, `path.2`, ... (written by
     `save_checkpoint_atomic(keep=N)`); only when every generation fails
     does the error propagate, carrying each failure."""
-    import sys
-
     candidates = checkpoint_generations(path)
     if not candidates:
         raise FileNotFoundError(f"no checkpoint at {path!r}")
@@ -557,12 +636,11 @@ def load_checkpoint_with_fallback(path: str, metrics=None):
         if cand != path:
             if metrics is not None:
                 metrics.inc("checkpoint_fallbacks")
-            print(
-                f"[stateright_tpu] checkpoint {path!r} rejected "
-                f"({failures[-1] if failures else 'missing'}); resuming from "
-                f"previous generation {cand!r}",
-                file=sys.stderr,
-                flush=True,
+            _log.warning(
+                "checkpoint rejected; resuming from previous generation",
+                path=path,
+                reason=failures[-1] if failures else "missing",
+                fallback=cand,
             )
         return arrays, meta
     raise CheckpointCorruptError(

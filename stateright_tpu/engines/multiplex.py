@@ -35,6 +35,7 @@ thousand-check sweep resumes instead of restarting.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -502,6 +503,7 @@ def run_multiplexed(
         n = len(batch)
         vals = None
         resumed = False
+        batch_secs = 0.0
         if resume_from is not None:
             snap = _load_batch_snapshot(resume_from, off, n, tm, tprops, shape)
             if snap is not None:
@@ -526,11 +528,13 @@ def run_multiplexed(
                 params[i] = lane_params(b)
             rec_fp = jnp.zeros((lanes, P), dtype=jnp.uint32)
 
+            _era_t0 = time.monotonic()
             tables_dev, params_dev = program(
                 jnp.asarray(qinit), jnp.asarray(n_inits), jnp.asarray(h1),
                 jnp.asarray(h2), jnp.asarray(params), rec_fp, rec_fp,
             )
             vals = np.asarray(params_dev)  # ONE readback for the whole batch
+            batch_secs = time.monotonic() - _era_t0
             tables = _TableBundle(tables_dev)
 
         for i, b in enumerate(batch):
@@ -544,6 +548,12 @@ def run_multiplexed(
                 model, tprops, v, tables, i, n_init, cov,
                 lanes=lanes, chunk=chunk, tcap=tcap, init_rows=inits,
             )
+            if batch_secs > 0.0:
+                # Every lane shared the ONE fused dispatch+readback, so
+                # each reports the batch's era wall time (the phase) and
+                # its latency sample (the distribution twin).
+                checker._metrics.add_phase("device_era", batch_secs)
+                checker._metrics.observe("era_secs", batch_secs)
             if int(v[P_COUNT]) > 0 and not b.finish_when_.matches(
                 set(checker._discovery_fps), model.properties()
             ):

@@ -36,18 +36,22 @@ drive the same TLC-style path reconstruction (bfs.rs:380-409).
 from __future__ import annotations
 
 import os
-import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.log import get_logger
+
 _DEBUG = bool(os.environ.get("STPU_DEBUG"))
+_log = get_logger("engines.tpu_bfs")
 
 
 def _dbg(msg: str) -> None:
+    # STPU_DEBUG is its own opt-in gate, so the stream bypasses the
+    # logger threshold (force) — setting the env var IS the request.
     if _DEBUG:
-        print(f"[tpu_bfs {time.monotonic():.3f}] {msg}", file=sys.stderr, flush=True)
+        _log.force("debug", msg, t=round(time.monotonic(), 3))
 
 from ..checker import CheckerBuilder
 from ..core import Expectation
@@ -1176,9 +1180,11 @@ class TpuBfsChecker(HostEngineBase):
                 # The era's true wall time: dispatch through readback
                 # complete (dispatch alone returns immediately — JAX is
                 # async on this platform).
-                self._metrics.add_phase(
-                    "device_era", time.monotonic() - self._era_t0
-                )
+                era_dt = time.monotonic() - self._era_t0
+                self._metrics.add_phase("device_era", era_dt)
+                # Distribution twin of the cumulative phase: era latency
+                # percentiles for /stats and the Prometheus exposition.
+                self._metrics.observe("era_secs", era_dt)
                 self._era_t0 = None
             _dbg(
                 f"era result steps={vals[10]} gen={vals[8]} count={vals[1]} "
@@ -1464,12 +1470,12 @@ class TpuBfsChecker(HostEngineBase):
             return  # once per run
         self._hinted_small = True
         self._metrics.set_gauge("small_workload_hint", n)
-        print(
-            f"[stateright_tpu] small workload ({n} states {kind}, crossover "
-            f"~{SMALL_WORKLOAD_STATES}): spawn_bfs() on the host is "
-            "typically faster than spawn_tpu_bfs() here",
-            file=sys.stderr,
-            flush=True,
+        _log.warning(
+            "small workload: spawn_bfs() on the host is typically faster "
+            "than spawn_tpu_bfs() here",
+            states=n,
+            kind=kind,
+            crossover=SMALL_WORKLOAD_STATES,
         )
 
     def _profile_stages(self, table, queue) -> None:
@@ -1505,11 +1511,9 @@ class TpuBfsChecker(HostEngineBase):
             )
         except Exception as exc:
             self._metrics.set_gauge("stage_profile_error", repr(exc)[:200])
-            print(
-                f"[stateright_tpu] stage profiling failed (run results "
-                f"unaffected): {exc!r}",
-                file=sys.stderr,
-                flush=True,
+            _log.warning(
+                "stage profiling failed (run results unaffected)",
+                error=repr(exc),
             )
 
     def _grow_table(self, table):

@@ -17,6 +17,13 @@ Reference parity: src/checker/explorer.rs. Routes:
     snapshot (obs/coverage.py): per-action fire counts, dead actions,
     depth histogram, per-property eval/hit counts — feeding the
     dashboard's action bar chart + depth histogram panel;
+  - ``GET /events`` — Server-Sent Events stream (text/event-stream):
+    ``span`` events as the checker's spans complete (obs/spans.py) and
+    periodic ``metrics`` events carrying the numeric telemetry deltas
+    since the previous tick. ``?limit=N`` closes after N span events,
+    ``?duration=SECS`` after a wall-clock budget, ``?replay=N`` seeds
+    the stream with the last N already-recorded spans — together they
+    make the stream bounded for tests/CI;
   - ``GET /.explain/{fp}/{fp}/...`` — counterexample forensics for the
     state path named by the fingerprints: per-step action, field-level
     state diff, and property-predicate flips (`Path.explain_steps`);
@@ -33,6 +40,7 @@ UI can show live activity (explorer.rs:60-94).
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -41,10 +49,31 @@ from typing import Any, Dict, List, Optional
 
 from ..checker import Checker, CheckerBuilder
 from ..core import Model
+from ..obs.log import get_logger
+from ..obs.spans import SpanRecorder
 from ..path import Path
+
+_log = get_logger("explorer.server")
 
 _UI_DIR = FsPath(__file__).parent / "ui"
 _SNAPSHOT_REFRESH_SECS = 4.0  # explorer.rs:90-93
+_SSE_METRICS_INTERVAL_SECS = 1.0
+
+
+def numeric_leaves(snapshot: Dict, prefix: str = "") -> Dict[str, float]:
+    """Flatten a telemetry snapshot to its numeric leaves
+    (``phase_ms.device_era`` style dotted keys) — the unit the /events
+    ``metrics`` delta events diff against the previous tick."""
+    out: Dict[str, float] = {}
+    for key, value in snapshot.items():
+        dotted = f"{prefix}{key}"
+        if isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            out[dotted] = value
+        elif isinstance(value, dict):
+            out.update(numeric_leaves(value, dotted + "."))
+    return out
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -77,6 +106,98 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         except ValueError:
             self._send_json({"error": "request body is not valid JSON"}, 400)
             return None
+
+    # ---- Server-Sent Events (GET /events on the Explorer and serve) ----
+
+    def _sse_emit(self, event: str, payload) -> None:
+        data = json.dumps(payload)
+        self.wfile.write(f"event: {event}\ndata: {data}\n\n".encode())
+        self.wfile.flush()
+
+    def _serve_sse(self, recorder, query: str = "", telemetry=None) -> None:
+        """Stream ``span`` events (completions fanned out by a
+        SpanRecorder subscription) and periodic ``metrics`` events (the
+        numeric telemetry leaves that changed since the last tick).
+
+        Bounding knobs so tests/CI can consume a finite stream:
+        ``?limit=N`` (close after N span events), ``?duration=SECS``
+        (wall-clock budget), ``?replay=N`` (seed with the last N spans
+        already recorded — they count toward the limit). A disconnected
+        client just ends the stream; it never wedges the recorder
+        because the subscription queue drops when full."""
+        limit: Optional[int] = None
+        duration: Optional[float] = None
+        replay = 0
+        for part in query.split("&"):
+            name, _, value = part.partition("=")
+            try:
+                if name == "limit":
+                    limit = max(0, int(value))
+                elif name == "duration":
+                    duration = max(0.0, float(value))
+                elif name == "replay":
+                    replay = max(0, int(value))
+            except ValueError:
+                pass
+
+        sub = recorder.subscribe() if recorder is not None else None
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+            deadline = None if duration is None else time.time() + duration
+            sent = 0
+            last_leaves: Dict[str, float] = {}
+            last_tick = 0.0
+
+            if replay and recorder is not None:
+                for span in list(recorder.spans())[-replay:]:
+                    if limit is not None and sent >= limit:
+                        break
+                    self._sse_emit("span", span)
+                    sent += 1
+
+            while True:
+                now = time.time()
+                if deadline is not None and now >= deadline:
+                    break
+                if limit is not None and sent >= limit:
+                    break
+                if telemetry is not None and now - last_tick >= _SSE_METRICS_INTERVAL_SECS:
+                    last_tick = now
+                    leaves = numeric_leaves(telemetry())
+                    changed = {
+                        k: v
+                        for k, v in leaves.items()
+                        if last_leaves.get(k) != v
+                    }
+                    last_leaves = leaves
+                    if changed or not sent:
+                        self._sse_emit(
+                            "metrics", {"ts": now, "changed": changed}
+                        )
+                wait = 0.25
+                if deadline is not None:
+                    wait = min(wait, max(0.0, deadline - now))
+                span = None
+                if sub is not None:
+                    try:
+                        span = sub.get(timeout=wait)
+                    except queue.Empty:
+                        span = None
+                else:
+                    time.sleep(wait)
+                if span is not None:
+                    self._sse_emit("span", span)
+                    sent += 1
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away; nothing to clean up but the sub
+        finally:
+            if sub is not None and recorder is not None:
+                recorder.unsubscribe(sub)
 
 
 class _Snapshot:
@@ -317,6 +438,11 @@ class ExplorerServer:
         self.snapshot = _Snapshot()
         self.trace_path = trace  # recorded conformance trace to serve, if any
         builder.visitor(self.snapshot.visit)
+        # Attach a span recorder (unless the caller brought their own) so
+        # the on-demand engine's run/progress spans feed GET /events.
+        if getattr(builder, "span_recorder_", None) is None:
+            builder.spans(SpanRecorder())
+        self.spans = builder.span_recorder_
         self.checker = builder.spawn_on_demand()
         self.model = self.checker.model()
 
@@ -346,6 +472,12 @@ class ExplorerServer:
                         self._send_json(_metrics_view(explorer.checker))
                 elif path in ("/coverage", "/.coverage"):
                     self._send_json(_coverage_view(explorer.checker))
+                elif path in ("/events", "/.events"):
+                    self._serve_sse(
+                        explorer.spans,
+                        query,
+                        telemetry=lambda: _metrics_view(explorer.checker),
+                    )
                 elif path in ("/trace", "/.trace"):
                     try:
                         self._send_json(_trace_view(explorer.trace_path, query))
@@ -401,7 +533,7 @@ class ExplorerServer:
         return f"http://{host}:{port}/"
 
     def serve_forever(self):
-        print(f"Explorer ready. {self.url}")
+        _log.info("explorer ready", url=self.url)
         self._rearm_thread.start()
         try:
             self.httpd.serve_forever()

@@ -259,6 +259,86 @@ async function pollCoverage() {
   setTimeout(pollCoverage, 2000);
 }
 
+// ---- span waterfall (run ledger) -------------------------------------------
+// Span completions arrive live over GET /events (SSE, obs/spans.py). The
+// waterfall draws the most recent trace's spans as horizontal bars on a
+// shared time axis — a job timeline when pointed at the run service, the
+// checking run's phases here on the Explorer.
+
+const spanLedger = []; // bounded recent span completions
+const SPAN_WINDOW = 200;
+const WF_ROWS = 40;
+
+function renderWaterfall() {
+  if (!spanLedger.length) return;
+  $("spans-panel").hidden = false;
+  const latest = spanLedger[spanLedger.length - 1].trace_id;
+  const spans = spanLedger
+    .filter((s) => s.trace_id === latest)
+    .slice()
+    .sort((a, b) => a.start - b.start);
+  const t0 = Math.min(...spans.map((s) => s.start));
+  const t1 = Math.max(...spans.map((s) => s.end), t0 + 1e-6);
+  const depth = {}; // span_id -> indent by parent chain
+  for (const s of spans) {
+    depth[s.span_id] =
+      s.parent_id != null && depth[s.parent_id] != null
+        ? depth[s.parent_id] + 1
+        : 0;
+  }
+  const box = $("waterfall");
+  box.innerHTML = "";
+  for (const s of spans.slice(-WF_ROWS)) {
+    const ms = (s.end - s.start) * 1000;
+    const row = document.createElement("div");
+    row.className = "wf-row" + (s.status && s.status !== "ok" ? " wf-err" : "");
+    const label = document.createElement("span");
+    label.className = "wf-label";
+    label.style.paddingLeft = (depth[s.span_id] || 0) * 10 + "px";
+    label.textContent = s.name;
+    const track = document.createElement("span");
+    track.className = "wf-track";
+    const bar = document.createElement("span");
+    bar.className = "wf-bar";
+    bar.style.left = (((s.start - t0) / (t1 - t0)) * 100).toFixed(2) + "%";
+    bar.style.width =
+      Math.max(0.5, ((s.end - s.start) / (t1 - t0)) * 100).toFixed(2) + "%";
+    bar.title = `${s.name}: ${ms.toFixed(1)} ms (${s.status || "ok"})`;
+    track.appendChild(bar);
+    const dur = document.createElement("span");
+    dur.className = "wf-dur";
+    dur.textContent = ms.toFixed(1) + " ms";
+    row.appendChild(label);
+    row.appendChild(track);
+    row.appendChild(dur);
+    box.appendChild(row);
+  }
+  $("wf-readout").textContent =
+    `trace ${latest.slice(0, 8)}… · ${spans.length} spans · ` +
+    ((t1 - t0) * 1000).toFixed(1) + " ms total";
+}
+
+function startSpanStream() {
+  let stream;
+  try {
+    stream = new EventSource("/events?replay=" + SPAN_WINDOW);
+  } catch (e) {
+    return; // SSE unavailable: leave the panel hidden
+  }
+  stream.addEventListener("span", (ev) => {
+    try {
+      spanLedger.push(JSON.parse(ev.data));
+    } catch (e) {
+      return;
+    }
+    if (spanLedger.length > SPAN_WINDOW) spanLedger.shift();
+    renderWaterfall();
+  });
+  stream.onerror = () => {
+    /* server restarting: EventSource retries on its own */
+  };
+}
+
 // ---- path explain (counterexample forensics) -------------------------------
 
 $("explain-path").addEventListener("click", async () => {
@@ -386,4 +466,5 @@ window.addEventListener("hashchange", () => {
 pollStatus();
 pollMetrics();
 pollCoverage();
+startSpanStream();
 loadStates();

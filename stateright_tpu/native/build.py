@@ -11,6 +11,10 @@ import shutil
 import subprocess
 import sys
 
+from ..obs.log import get_logger
+
+_log = get_logger("native.build")
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 # (source, output) pairs; each is an independent shared object.
@@ -30,7 +34,7 @@ def build_one(source: str, output: str, quiet: bool = False) -> bool:
     gxx = shutil.which("g++") or shutil.which("c++")
     if gxx is None:
         if not quiet:
-            print("native build: no C++ compiler found", file=sys.stderr)
+            _log.warning("native build: no C++ compiler found")
         return False
     cmd = [
         gxx,
@@ -47,11 +51,13 @@ def build_one(source: str, output: str, quiet: bool = False) -> bool:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except Exception as e:
         if not quiet:
-            print(f"native build failed to run: {e}", file=sys.stderr)
+            _log.warning("native build failed to run", error=repr(e))
         return False
     if proc.returncode != 0:
         if not quiet:
-            print(proc.stderr, file=sys.stderr)
+            _log.warning(
+                "native build failed", compiler=gxx, stderr=proc.stderr
+            )
         return False
     return True
 

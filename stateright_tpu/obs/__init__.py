@@ -18,7 +18,9 @@ coverage-count semantics, and `obs/trace.py` for the trace event schema.
 """
 
 from .coverage import DEPTH_CAP, Coverage
-from .metrics import MetricsRegistry, render_prometheus
+from .log import get_logger
+from .metrics import Histogram, MetricsRegistry, render_prometheus
+from .spans import SpanRecorder, attach_phase_spans, new_span_id, new_trace_id
 from .stageprof import STAGE_ORDER, stage_rows
 from .trace import (
     ChromeTraceWriter,
@@ -32,10 +34,16 @@ __all__ = [
     "DEPTH_CAP",
     "ChromeTraceWriter",
     "Coverage",
+    "Histogram",
     "MetricsRegistry",
     "STAGE_ORDER",
+    "SpanRecorder",
     "TraceWriter",
+    "attach_phase_spans",
+    "get_logger",
     "make_trace_writer",
+    "new_span_id",
+    "new_trace_id",
     "render_prometheus",
     "stage_rows",
     "start_profile",

@@ -1,0 +1,156 @@
+"""Leveled JSON-lines structured logger for library code.
+
+Library components (engines, serve, durability) must not `print()`: a
+run server's operator needs grep-able, machine-parseable lines with a
+component name, a level, and — when the message concerns a job — the
+job's trace_id, so a log line joins the span ledger on the same key.
+
+One line per event::
+
+    {"ts": 1754380800.123, "level": "warning", "component": "serve.http",
+     "msg": "journal replay recovered jobs", "recovered": 3,
+     "trace_id": "9f86d081..."}
+
+Usage::
+
+    from stateright_tpu.obs.log import get_logger
+    log = get_logger("engines.common")
+    log.warning("checkpoint rejected", path=path, error=str(err))
+
+Configuration is environment-first (no setup call needed):
+
+  ``STATERIGHT_LOG``       minimum level: debug|info|warning|error|off
+                           (default ``warning`` — library code stays
+                           quiet unless something needs attention)
+  ``STATERIGHT_LOG_FILE``  sink path (append mode); default stderr.
+
+`configure(level=..., sink=...)` overrides both at runtime (tests use a
+list sink to capture records). Loggers are cheap views over one shared
+module-level config, so `configure` affects every component at once.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+__all__ = ["Logger", "configure", "get_logger", "LEVELS"]
+
+LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "off": 100}
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"threshold": None, "sink": None}
+
+
+def _env_threshold() -> int:
+    name = os.environ.get("STATERIGHT_LOG", "warning").strip().lower()
+    return LEVELS.get(name, LEVELS["warning"])
+
+
+def configure(
+    level: Optional[str] = None,
+    sink: Optional[Union[str, List[Dict[str, Any]], Callable, io.IOBase]] = None,
+) -> None:
+    """Override the env config. `level` is a LEVELS name; `sink` is a
+    file path (append), a file-like object, a callable taking the record
+    dict, or a list to append record dicts to (test capture). Pass
+    nothing to reset back to environment-driven behavior."""
+    with _lock:
+        if level is None and sink is None:
+            _state["threshold"] = None
+            _state["sink"] = None
+            return
+        if level is not None:
+            if level not in LEVELS:
+                raise ValueError(f"unknown log level {level!r}; use one of {sorted(LEVELS)}")
+            _state["threshold"] = LEVELS[level]
+        if sink is not None:
+            _state["sink"] = sink
+
+
+def _emit(record: Dict[str, Any]) -> None:
+    with _lock:
+        sink = _state["sink"]
+        if sink is None:
+            sink = os.environ.get("STATERIGHT_LOG_FILE") or None
+        if isinstance(sink, list):
+            sink.append(record)
+            return
+        if callable(sink) and not isinstance(sink, io.IOBase):
+            sink(record)
+            return
+        line = json.dumps(record, default=repr)
+        if isinstance(sink, str):
+            with open(sink, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+            return
+        stream = sink if sink is not None else sys.stderr
+        try:
+            stream.write(line + "\n")
+            stream.flush()
+        except (ValueError, OSError):
+            pass  # a closed stderr (test teardown) must not crash the caller
+
+
+class Logger:
+    """A component-scoped view over the shared log config."""
+
+    __slots__ = ("component",)
+
+    def __init__(self, component: str):
+        self.component = component
+
+    def enabled(self, level: str) -> bool:
+        with _lock:
+            threshold = _state["threshold"]
+        if threshold is None:
+            threshold = _env_threshold()
+        return LEVELS.get(level, 0) >= threshold
+
+    def log(self, level: str, msg: str, **fields: Any) -> None:
+        if not self.enabled(level):
+            return
+        self.force(level, msg, **fields)
+
+    def force(self, level: str, msg: str, **fields: Any) -> None:
+        """Emit regardless of the configured threshold — for channels
+        with their own explicit opt-in gate (e.g. the device engine's
+        ``STPU_DEBUG`` stream), where setting the gate IS the request
+        for output."""
+        record: Dict[str, Any] = {
+            "ts": round(time.time(), 6),
+            "level": level,
+            "component": self.component,
+            "msg": msg,
+        }
+        record.update(fields)
+        _emit(record)
+
+    def debug(self, msg: str, **fields: Any) -> None:
+        self.log("debug", msg, **fields)
+
+    def info(self, msg: str, **fields: Any) -> None:
+        self.log("info", msg, **fields)
+
+    def warning(self, msg: str, **fields: Any) -> None:
+        self.log("warning", msg, **fields)
+
+    def error(self, msg: str, **fields: Any) -> None:
+        self.log("error", msg, **fields)
+
+
+_loggers: Dict[str, Logger] = {}
+
+
+def get_logger(component: str) -> Logger:
+    """The (cached) logger for a dotted component name."""
+    with _lock:
+        logger = _loggers.get(component)
+        if logger is None:
+            logger = _loggers[component] = Logger(component)
+        return logger

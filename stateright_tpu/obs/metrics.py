@@ -178,15 +178,43 @@ dict in `snapshot()`:
                          (outside ``device_era``; the timed run is clean)
   =====================  =====================================================
 
+Histograms (`observe`) — log-spaced latency distributions, surfaced as
+the nested ``histograms`` dict in `snapshot()` (per histogram: ``count``,
+``sum``, cumulative ``buckets`` as ``[le, count]`` pairs, and
+interpolated ``p50``/``p95``/``p99``), and rendered by
+`render_prometheus` as classic ``_bucket{le=...}`` / ``_sum`` /
+``_count`` families:
+
+  ==========================  ================================================
+  name                        observes (seconds)
+  ==========================  ================================================
+  ``submit_to_result_secs``   serve job latency, submission acknowledged to
+                              result recorded — retries, backoff waits, and
+                              queue time all included (serve/service.py);
+                              ``/stats``'s ``latency`` section reports its
+                              p50/p95/p99
+  ``queue_wait_secs``         serve job queue residency, enqueue to worker
+                              pickup (re-observed per requeue)
+  ``era_secs``                one device era dispatch→readback (device
+                              engines and multiplex lanes; the distribution
+                              twin of the cumulative ``device_era`` phase)
+  ==========================  ================================================
+
+Span phases — when a `SpanRecorder` (obs/spans.py) is attached, every
+phase timer above ALSO appears as a ``phase:<name>`` child span of the
+run/job span, so a Perfetto waterfall shows where a request's wall time
+went without new instrumentation in the hot loops.
+
 Engines only populate the rows that exist on their architecture; absent
 phases simply never appear in the snapshot.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
 class _PhaseTimer:
@@ -206,6 +234,115 @@ class _PhaseTimer:
         self._registry.add_phase(self._name, time.monotonic() - self._t0)
 
 
+def _log_bounds(start: float, factor: float, count: int) -> Tuple[float, ...]:
+    bounds = []
+    edge = start
+    for _ in range(count):
+        bounds.append(edge)
+        edge *= factor
+    return tuple(bounds)
+
+
+#: Default log-spaced bucket bounds (seconds): 100 µs doubling up to ~14 min.
+#: 24 finite edges keep the Prometheus exposition compact while spanning
+#: every latency this system produces, from one fused-era readback to a
+#: deep 2pc-9 serve job with backoff retries.
+DEFAULT_BOUNDS = _log_bounds(1e-4, 2.0, 24)
+
+
+class Histogram:
+    """Thread-safe log-spaced histogram with Prometheus semantics.
+
+    Buckets are cumulative at export (`le` upper bounds, implicit +Inf),
+    exactly the `_bucket/_sum/_count` contract scrapers expect.
+    `quantile()` interpolates linearly inside the winning bucket — the
+    standard Prometheus `histogram_quantile` estimate, so p99 here and
+    p99 in Grafana agree."""
+
+    __slots__ = ("_lock", "bounds", "_counts", "_sum", "_count", "_max")
+
+    def __init__(self, bounds: Optional[Sequence[float]] = None):
+        edges = tuple(sorted(bounds)) if bounds else DEFAULT_BOUNDS
+        if not edges:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._lock = threading.Lock()
+        self.bounds = edges
+        self._counts = [0] * (len(edges) + 1)  # +1 for the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._max = 0.0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            if value > self._max:
+                self._max = value
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0..1), linearly interpolated within the
+        winning bucket; the +Inf bucket clamps to the observed max."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            rank = q * self._count
+            cum = 0
+            for idx, n in enumerate(self._counts):
+                cum += n
+                if cum >= rank and n:
+                    if idx >= len(self.bounds):
+                        return self._max
+                    hi = self.bounds[idx]
+                    lo = self.bounds[idx - 1] if idx else 0.0
+                    frac = (rank - (cum - n)) / n
+                    return min(lo + (hi - lo) * frac, self._max or hi)
+            return self._max
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs, +Inf last (Prometheus shape)."""
+        with self._lock:
+            out = []
+            cum = 0
+            for edge, n in zip(self.bounds, self._counts):
+                cum += n
+                out.append((edge, cum))
+            out.append((float("inf"), cum + self._counts[-1]))
+            return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-friendly export: count/sum/max, cumulative buckets, and
+        the three operator quantiles (p50/p95/p99)."""
+        buckets = self.buckets()
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "max": round(mx, 6),
+            "buckets": [
+                ["+Inf" if le == float("inf") else le, n] for le, n in buckets
+            ],
+            "p50": round(self.quantile(0.50), 6),
+            "p95": round(self.quantile(0.95), 6),
+            "p99": round(self.quantile(0.99), 6),
+        }
+
+
 class MetricsRegistry:
     """Thread-safe counters + gauges + phase timers for one checker run."""
 
@@ -215,6 +352,7 @@ class MetricsRegistry:
         self._gauges: Dict[str, Any] = {}
         self._phase_secs: Dict[str, float] = {}
         self._phase_calls: Dict[str, int] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     # -- counters ------------------------------------------------------------
 
@@ -263,11 +401,28 @@ class MetricsRegistry:
                 for k, v in sorted(self._phase_secs.items())
             }
 
+    # -- histograms ----------------------------------------------------------
+
+    def histogram(
+        self, name: str, bounds: Optional[Sequence[float]] = None
+    ) -> Histogram:
+        """The named histogram, created on first use (catalog above)."""
+        with self._lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = self._histograms[name] = Histogram(bounds)
+            return hist
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into the named histogram."""
+        self.histogram(name).observe(value)
+
     # -- export --------------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
         """Flat counters + gauges, plus nested ``phase_ms`` when any phase
-        has been timed. This is what `Checker.telemetry()` returns."""
+        has been timed and nested ``histograms`` when any sample has been
+        observed. This is what `Checker.telemetry()` returns."""
         with self._lock:
             out: Dict[str, Any] = {
                 k: dict(v) if isinstance(v, dict) else v
@@ -279,6 +434,11 @@ class MetricsRegistry:
                     k: round(v * 1000.0, 3)
                     for k, v in sorted(self._phase_secs.items())
                 }
+            hists = dict(self._histograms)
+        if hists:
+            out["histograms"] = {
+                name: hists[name].snapshot() for name in sorted(hists)
+            }
         return out
 
 
@@ -325,6 +485,18 @@ def render_prometheus(
             lines.append(f"# TYPE {name} untyped")
             for phase in sorted(value):
                 lines.append(f'{name}{{phase="{phase}"}} {value[phase]}')
+            continue
+        if key == "histograms" and isinstance(value, dict):
+            for hist_name in sorted(value):
+                snap = value[hist_name]
+                if not isinstance(snap, dict) or "buckets" not in snap:
+                    continue
+                name = _prom_name(hist_name, prefix)
+                lines.append(f"# TYPE {name} histogram")
+                for le, n in snap["buckets"]:
+                    lines.append(f'{name}_bucket{{le="{le}"}} {n}')
+                lines.append(f'{name}_sum {snap.get("sum", 0)}')
+                lines.append(f'{name}_count {snap.get("count", 0)}')
             continue
         if key in labels and isinstance(value, dict):
             name = _prom_name(key, prefix)

@@ -157,6 +157,23 @@ class ChromeTraceWriter:
                 )
             self._f.flush()
 
+    def embed_spans(self, spans) -> int:
+        """Merge completed span dicts (obs/spans.py SpanRecorder shape)
+        into the open trace as B/E duration pairs. Spans use the same
+        time.time()-derived microsecond clock as emit(), so one Perfetto
+        file shows engine phases and request spans aligned. Returns the
+        number of trace-event records written."""
+        from .spans import spans_to_chrome
+
+        events = spans_to_chrome(spans)
+        with self._lock:
+            if self._f.closed:
+                return 0
+            for record in events:
+                self._write(record)
+            self._f.flush()
+        return len(events)
+
     def close(self) -> None:
         with self._lock:
             if not self._f.closed:

@@ -1255,14 +1255,12 @@ class ShardedBfsChecker(HostEngineBase):
                 self._metrics, timed, era_secs, steps, self._stage_iters
             )
         except Exception as exc:
-            import sys
+            from ..obs.log import get_logger
 
             self._metrics.set_gauge("stage_profile_error", repr(exc)[:200])
-            print(
-                f"[stateright_tpu] stage profiling failed (run results "
-                f"unaffected): {exc!r}",
-                file=sys.stderr,
-                flush=True,
+            get_logger("parallel.mesh").warning(
+                "stage profiling failed (run results unaffected)",
+                error=repr(exc),
             )
 
     # -- checkpoint/resume --------------------------------------------------

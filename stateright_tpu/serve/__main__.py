@@ -7,7 +7,8 @@ from __future__ import annotations
 
 import argparse
 
-from .http import serve
+from .http import ServeServer
+from .service import RunService
 
 
 def main(argv=None) -> int:
@@ -34,14 +35,28 @@ def main(argv=None) -> int:
         "--per-minute", type=int, default=600,
         help="per-tenant submissions-per-minute rate limit",
     )
-    args = parser.parse_args(argv)
-    serve(
-        args.address,
-        workers=args.workers,
-        lanes=args.lanes,
-        quota_max_active=args.max_active,
-        quota_per_minute=args.per_minute,
+    parser.add_argument(
+        "--log-level",
+        default=None,
+        choices=["debug", "info", "warning", "error", "off"],
+        help="structured-log threshold (default: $STATERIGHT_LOG or warning)",
     )
+    args = parser.parse_args(argv)
+    if args.log_level:
+        from ..obs.log import configure
+
+        configure(level=args.log_level)
+    server = ServeServer(
+        RunService(
+            workers=args.workers,
+            lanes=args.lanes,
+            quota_max_active=args.max_active,
+            quota_per_minute=args.per_minute,
+        ),
+        args.address,
+    )
+    print(f"Run service ready. {server.url}")
+    server.serve_forever()
     return 0
 
 

@@ -51,6 +51,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs.log import get_logger
+
+_log = get_logger("serve.durability")
+
 __all__ = [
     "CircuitBreaker",
     "JobJournal",
@@ -280,12 +284,14 @@ class JobJournal:
         folded: Dict[str, Dict[str, Any]] = {}
         if not os.path.exists(path):
             return folded
+        torn = 0
         with open(path, encoding="utf-8") as fh:
             for line in fh:
                 try:
                     rec = json.loads(line)
                 except ValueError:
-                    continue  # torn tail from a kill mid-append
+                    torn += 1  # torn tail from a kill mid-append
+                    continue
                 kind = rec.get("rec")
                 if kind == "submit":
                     job = rec.get("job") or {}
@@ -312,6 +318,11 @@ class JobJournal:
                 elif kind == "retry":
                     entry["status"] = "queued"
                     entry["error"] = None
+        if torn:
+            _log.warning(
+                "journal replay skipped unparsable lines",
+                path=path, skipped=torn,
+            )
         return folded
 
     def compact(self, folded: Dict[str, Dict[str, Any]]) -> None:
@@ -355,6 +366,7 @@ class JobJournal:
             self._fh.close()
             os.replace(tmp, self.path)
             self._fh = open(self.path, "a", encoding="utf-8")
+        _log.info("journal compacted", path=self.path, jobs=len(folded))
         if self._metrics is not None:
             self._metrics.inc("journal_compactions")
 
@@ -436,8 +448,13 @@ class ResultStore:
                 except OSError:
                     continue
                 expired.append(name[: -len(".json")])
-        if expired and self._metrics is not None:
-            self._metrics.inc("serve_results_gc", len(expired))
+        if expired:
+            _log.info(
+                "result store expired results",
+                root=self.root, expired=len(expired),
+            )
+            if self._metrics is not None:
+                self._metrics.inc("serve_results_gc", len(expired))
         return expired
 
     def stats(self) -> Dict[str, Any]:

@@ -13,6 +13,17 @@ Routes (JSON in/out unless noted):
   ``GET /jobs/{id}``              one job's status view
   ``GET /jobs/{id}/result``       the finished job's results (404
                                   unknown, 409 while queued/running)
+  ``GET /jobs/{id}/trace``        the job's span ledger (obs/spans.py):
+                                  every recorded span sharing the job's
+                                  trace_id — admission, queue waits,
+                                  executions (with engine phases),
+                                  backoff windows, restart recoveries,
+                                  the result write and the root span
+  ``GET /events``                 Server-Sent Events stream: ``span``
+                                  events as spans complete + periodic
+                                  ``metrics`` delta events; bounded via
+                                  ``?limit=N`` / ``?duration=SECS`` /
+                                  ``?replay=N`` (see explorer/server.py)
   ``POST /jobs/{id}/cancel``      cancel a queued job (409 otherwise)
   ``POST /jobs/{id}/retry``       admin re-enqueue of a failed or
                                   cancelled job (409 otherwise; resets
@@ -36,10 +47,13 @@ from http.server import ThreadingHTTPServer
 from typing import Optional
 
 from ..explorer.server import JsonRequestHandler
+from ..obs.log import get_logger
 from ..obs.metrics import render_prometheus
 from .service import RunService
 
 __all__ = ["ServeServer", "serve"]
+
+_log = get_logger("serve.http")
 
 
 class ServeServer:
@@ -76,6 +90,8 @@ class ServeServer:
                     )
                 elif path == "/metrics":
                     self._send_json(svc.telemetry())
+                elif path == "/events":
+                    self._serve_sse(svc.spans, query, telemetry=svc.telemetry)
                 elif path == "/jobs":
                     tenant = None
                     for part in query.split("&"):
@@ -88,6 +104,22 @@ class ServeServer:
                         self._send_json({"error": f"no job {parts[1]!r}"}, 404)
                     else:
                         self._send_json(job.view())
+                elif (
+                    len(parts) == 3
+                    and parts[0] == "jobs"
+                    and parts[2] == "trace"
+                ):
+                    job = svc.job(parts[1])
+                    if job is None:
+                        self._send_json({"error": f"no job {parts[1]!r}"}, 404)
+                    else:
+                        self._send_json(
+                            {
+                                "job_id": job.id,
+                                "trace_id": job.trace_id,
+                                "spans": svc.trace(job.trace_id),
+                            }
+                        )
                 elif (
                     len(parts) == 3
                     and parts[0] == "jobs"
@@ -154,7 +186,7 @@ class ServeServer:
         return f"http://{host}:{port}/"
 
     def serve_forever(self):
-        print(f"Run service ready. {self.url}")
+        _log.info("run service ready", url=self.url)
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:

@@ -52,7 +52,14 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..engines.compiled import ExecutableCache, model_signature
+from ..obs.log import get_logger
 from ..obs.metrics import MetricsRegistry
+from ..obs.spans import (
+    SpanRecorder,
+    attach_phase_spans,
+    new_span_id,
+    new_trace_id,
+)
 from ..tensor import TensorModel, TensorModelAdapter
 from .durability import (
     CircuitBreaker,
@@ -64,16 +71,27 @@ from .durability import (
 
 __all__ = ["Job", "RunService"]
 
+_log = get_logger("serve.service")
+
 _RATE_WINDOW_SECS = 60.0
 
 
 class Job:
-    """One submitted check, from admission through results."""
+    """One submitted check, from admission through results.
+
+    Every job IS one trace in the run ledger (obs/spans.py): `trace_id`
+    names it end-to-end and `root_span_id` is the pre-assigned id of the
+    root "job" span (sealed at finish), so admission/queue/execute child
+    spans parent to it while the job is still in flight. Both ride
+    `journal_fields()` into the write-ahead journal, which is what makes
+    a crash→restart replay CONTINUE the same trace instead of opening a
+    new one."""
 
     __slots__ = (
         "id", "tenant", "spec", "engine", "priority", "status",
         "submitted_at", "started_at", "finished_at", "error", "result",
         "signature", "model", "options", "attempts",
+        "trace_id", "root_span_id", "enqueued_at", "backoff_since",
     )
 
     def __init__(self, tenant: str, spec: str, engine: str, priority: int,
@@ -94,6 +112,13 @@ class Job:
         self.model = model
         self.options = options
         self.attempts = 0
+        self.trace_id = new_trace_id()
+        self.root_span_id = new_span_id()
+        # When the job last entered the queue (reset per requeue) — the
+        # start of the current queue_wait span.
+        self.enqueued_at = self.submitted_at
+        # When the job entered its current backoff window, if any.
+        self.backoff_since: Optional[float] = None
 
     def journal_fields(self) -> Dict[str, Any]:
         """The job's identity as the write-ahead journal records it —
@@ -107,6 +132,8 @@ class Job:
             "priority": self.priority,
             "options": self.options,
             "submitted_at": self.submitted_at,
+            "trace_id": self.trace_id,
+            "root_span_id": self.root_span_id,
         }
 
     @classmethod
@@ -119,6 +146,10 @@ class Job:
         )
         job.id = fields["id"]
         job.submitted_at = fields.get("submitted_at", job.submitted_at)
+        # Pre-PR-12 journals have no trace ids; the fresh ones from the
+        # constructor keep those jobs traceable from the restart on.
+        job.trace_id = fields.get("trace_id") or job.trace_id
+        job.root_span_id = fields.get("root_span_id") or job.root_span_id
         return job
 
     def view(self) -> Dict[str, Any]:
@@ -133,6 +164,7 @@ class Job:
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "attempts": self.attempts,
+            "trace_id": self.trace_id,
         }
         if self.error is not None:
             out["error"] = self.error
@@ -194,6 +226,9 @@ class RunService:
         self.lint_samples = lint_samples
 
         self.metrics = MetricsRegistry()
+        # The run ledger: every job's spans land here; GET /events streams
+        # completions live and /jobs/{id}/trace serves whole waterfalls.
+        self.spans = SpanRecorder(metrics=self.metrics)
         self.cache = ExecutableCache(capacity=exec_cache_capacity)
         self._cv = threading.Condition()
         self._heap: List[Tuple[int, int, Job]] = []
@@ -302,6 +337,7 @@ class RunService:
                 job.finished_at = time.time()
             else:
                 job.status = "queued"
+                job.enqueued_at = time.time()
                 heapq.heappush(
                     self._heap, (-job.priority, next(self._seq), job)
                 )
@@ -309,7 +345,30 @@ class RunService:
                     "journal_recovered_running" if status == "running"
                     else "journal_recovered_queued"
                 )
+                # The recovery joins the job's ORIGINAL trace (the ids
+                # rode the journal): one continuous waterfall across the
+                # crash, with the restart visible as its own span.
+                self.spans.record(
+                    "restart_recovery",
+                    start=job.enqueued_at,
+                    end=job.enqueued_at,
+                    trace_id=job.trace_id,
+                    parent_id=job.root_span_id,
+                    attributes={
+                        "job_id": job.id,
+                        "was": status,
+                        "attempt": job.attempts,
+                    },
+                )
         self._update_gauges_locked()
+        if self._jobs:
+            _log.info(
+                "journal replay recovered jobs",
+                replayed=len(self._jobs),
+                requeued=self.metrics.get("journal_recovered_queued")
+                + self.metrics.get("journal_recovered_running"),
+                done=self.metrics.get("journal_recovered_done"),
+            )
         self._journal.compact(self._folded_state())
 
     def _folded_state(self) -> Dict[str, Dict[str, Any]]:
@@ -370,6 +429,7 @@ class RunService:
     def submit(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         """Admit one submission. Returns ``(http_status, body)``:
         202 queued, 400 malformed, 422 speclint rejection, 429 quota."""
+        admit_t0 = time.time()
         self.metrics.inc("serve_requests")
         spec = payload.get("spec") or payload.get("model")
         tenant = str(payload.get("tenant") or "default")
@@ -420,6 +480,18 @@ class RunService:
                 return 400, {"error": "'target_max_depth' must be an integer"}
 
         job = Job(tenant, spec, engine, priority, model, signature, options)
+        # The trace opens: lint + quota + resolution was the admission
+        # leg, and the root "job" span starts where the request arrived.
+        job.submitted_at = admit_t0
+        job.enqueued_at = time.time()
+        self.spans.record(
+            "admission",
+            start=admit_t0,
+            end=job.enqueued_at,
+            trace_id=job.trace_id,
+            parent_id=job.root_span_id,
+            attributes={"job_id": job.id, "spec": spec, "tenant": tenant},
+        )
         with self._cv:
             self._jobs[job.id] = job
             heapq.heappush(self._heap, (-priority, next(self._seq), job))
@@ -431,7 +503,9 @@ class RunService:
                 # start for it — appends order under this lock).
                 self._journal.submit(job.journal_fields())
             self._cv.notify()
-        return 202, {"job_id": job.id, "status": "queued"}
+        return 202, {
+            "job_id": job.id, "status": "queued", "trace_id": job.trace_id,
+        }
 
     def _check_quota(self, tenant: str):
         with self._cv:
@@ -503,6 +577,19 @@ class RunService:
             self._update_gauges_locked()
             if self._journal is not None:
                 self._journal.cancel(job.id)
+        # A cancel seals the trace: the root span closes as cancelled.
+        self.spans.record(
+            "job",
+            start=job.submitted_at,
+            end=job.finished_at,
+            trace_id=job.trace_id,
+            span_id=job.root_span_id,
+            status="cancelled",
+            attributes={
+                "job_id": job.id, "spec": job.spec, "tenant": job.tenant,
+                "final_status": "cancelled",
+            },
+        )
         return 200, job.view()
 
     def retry_job(self, job_id: str) -> Tuple[int, Dict[str, Any]]:
@@ -555,12 +642,34 @@ class RunService:
                 },
                 "retry": self.retry.view(),
                 "breaker": self.breaker.snapshot(),
+                "latency": self._latency_stats(),
             }
             if self._journal is not None:
                 out["journal"] = self._journal.stats()
             if self._results is not None:
                 out["results"] = self._results.stats()
             return out
+
+    def _latency_stats(self) -> Dict[str, Any]:
+        """p50/p95/p99 seconds for the two service-level distributions
+        (the full cumulative histograms ride `telemetry()`)."""
+        out: Dict[str, Any] = {}
+        for key, name in (
+            ("submit_to_result", "submit_to_result_secs"),
+            ("queue_wait", "queue_wait_secs"),
+        ):
+            h = self.metrics.histogram(name)
+            out[key] = {
+                "count": h.count,
+                "p50": round(h.quantile(0.50), 6),
+                "p95": round(h.quantile(0.95), 6),
+                "p99": round(h.quantile(0.99), 6),
+            }
+        return out
+
+    def trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """One trace's completed spans in waterfall order (obs/spans.py)."""
+        return self.spans.trace(trace_id)
 
     def telemetry(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()
@@ -611,6 +720,16 @@ class RunService:
             j.status = "running"
             j.started_at = now
             j.attempts += 1
+            wait = max(0.0, now - j.enqueued_at)
+            self.metrics.observe("queue_wait_secs", wait)
+            self.spans.record(
+                "queue_wait",
+                start=j.enqueued_at,
+                end=now,
+                trace_id=j.trace_id,
+                parent_id=j.root_span_id,
+                attributes={"job_id": j.id, "attempt": j.attempts},
+            )
             if self._journal is not None:
                 self._journal.start(j.id, j.attempts)
         self._update_gauges_locked()
@@ -633,18 +752,48 @@ class RunService:
                 # Fast-fail while the breaker is open: repeated failures
                 # for this signature must not keep burning device time.
                 self.metrics.inc("serve_breaker_fastfail", len(batch))
+                now = time.time()
+                for j in batch:
+                    self.spans.record(
+                        "breaker_fastfail",
+                        start=now,
+                        end=now,
+                        trace_id=j.trace_id,
+                        parent_id=j.root_span_id,
+                        status="error",
+                        attributes={"job_id": j.id, "signature": key},
+                    )
                 self._finish(
                     batch,
                     error=f"circuit breaker open for {key!r} after repeated "
                     "failures; retry after the cooldown",
                 )
                 continue
+            exec_t0 = time.time()
             try:
                 if batch[0].engine == "multiplex":
                     self._run_multiplex_batch(batch)
                 else:
                     self._run_solo(batch[0])
             except Exception as e:
+                # The failed attempt is still a span in each job's trace
+                # (success spans are recorded by the run paths, which
+                # know the cache outcome and engine phase timings).
+                now = time.time()
+                msg = f"{type(e).__name__}: {e}"
+                for j in batch:
+                    self.spans.record(
+                        "execute",
+                        start=exec_t0,
+                        end=now,
+                        trace_id=j.trace_id,
+                        parent_id=j.root_span_id,
+                        status="error",
+                        attributes={
+                            "job_id": j.id, "engine": j.engine,
+                            "attempt": j.attempts, "error": msg,
+                        },
+                    )
                 self.breaker.record_failure(key)
                 self._handle_failure(batch, e)
             else:
@@ -670,6 +819,10 @@ class RunService:
             if escalate and j.engine == "multiplex":
                 j.engine = "tpu_bfs"
                 self.metrics.inc("retry_escalated_solo")
+                _log.info(
+                    "escalating multiplex lane to solo engine",
+                    job_id=j.id, trace_id=j.trace_id, attempt=j.attempts,
+                )
             delay = self.retry.delay(j.attempts, key=j.id)
             self.metrics.inc("retry_scheduled")
             with self._cv:
@@ -677,6 +830,7 @@ class RunService:
                 # the scheduler, still cancellable; the timer re-enqueues.
                 j.status = "queued"
                 j.error = msg  # last error, visible while waiting
+                j.backoff_since = time.time()
                 self._update_gauges_locked()
                 timer = threading.Timer(delay, self._requeue, args=(j,))
                 timer.daemon = True
@@ -689,6 +843,25 @@ class RunService:
             if self._stop or job.status != "queued":
                 return  # cancelled (or service stopping) while backing off
             job.error = None
+            now = time.time()
+            if job.backoff_since is not None:
+                # The wait itself is part of the job's story: a span in
+                # the ORIGINAL trace, carrying the engine it retries on
+                # (so an escalation reads right off the waterfall).
+                self.spans.record(
+                    "backoff_wait",
+                    start=job.backoff_since,
+                    end=now,
+                    trace_id=job.trace_id,
+                    parent_id=job.root_span_id,
+                    attributes={
+                        "job_id": job.id,
+                        "attempt": job.attempts,
+                        "next_engine": job.engine,
+                    },
+                )
+                job.backoff_since = None
+            job.enqueued_at = now  # fresh queue_wait leg
             heapq.heappush(self._heap, (-job.priority, next(self._seq), job))
             self._update_gauges_locked()
             if self._journal is not None:
@@ -696,23 +869,16 @@ class RunService:
             self._cv.notify()
 
     def _finish(self, jobs: List[Job], error: Optional[str] = None) -> None:
-        now = time.time()
-        with self._cv:
-            for j in jobs:
-                j.finished_at = now
-                if error is not None:
-                    j.status = "failed"
-                    j.error = error
-                    self.metrics.inc("serve_failed")
-                else:
-                    j.status = "done"
-                    self.metrics.inc("serve_completed")
-            self._update_gauges_locked()
-            self._cv.notify_all()
-        # Durability, outside the scheduler lock: the result payload
-        # lands on disk BEFORE the journal's terminal record, so replay
-        # never claims "done" without a readable result.
+        status = "failed" if error is not None else "done"
+        # Durability and the trace's closing spans land BEFORE the
+        # in-memory status flip: the result payload is on disk before the
+        # journal's terminal record (replay never claims "done" without a
+        # readable result), and a client that observes a terminal status
+        # is guaranteed the job's complete ledger — the root "job" span
+        # included.
         for j in jobs:
+            j.error = error if error is not None else j.error
+            write_t0 = time.time()
             if (
                 error is None
                 and self._results is not None
@@ -720,7 +886,56 @@ class RunService:
             ):
                 self._results.put(j.id, j.result)
             if self._journal is not None:
-                self._journal.result(j.id, j.status, error=j.error)
+                self._journal.result(j.id, status, error=j.error)
+            done_at = time.time()
+            if self._results is not None or self._journal is not None:
+                self.spans.record(
+                    "result_write",
+                    start=write_t0,
+                    end=done_at,
+                    trace_id=j.trace_id,
+                    parent_id=j.root_span_id,
+                    attributes={"job_id": j.id, "status": status},
+                )
+            # The trace closes: the root "job" span (its pre-assigned id
+            # is what every child above parented to) plus the job's
+            # submit→result latency sample.
+            self.metrics.observe(
+                "submit_to_result_secs", max(0.0, done_at - j.submitted_at)
+            )
+            self.spans.record(
+                "job",
+                start=j.submitted_at,
+                end=done_at,
+                trace_id=j.trace_id,
+                span_id=j.root_span_id,
+                status="ok" if error is None else "error",
+                attributes={
+                    "job_id": j.id,
+                    "spec": j.spec,
+                    "tenant": j.tenant,
+                    "engine": j.engine,
+                    "attempts": j.attempts,
+                    "final_status": status,
+                    **({"error": error} if error else {}),
+                },
+            )
+            if error is not None:
+                _log.warning(
+                    "job failed",
+                    job_id=j.id, trace_id=j.trace_id, spec=j.spec,
+                    attempts=j.attempts, error=error,
+                )
+        now = time.time()
+        with self._cv:
+            for j in jobs:
+                j.finished_at = now
+                j.status = status
+                self.metrics.inc(
+                    "serve_failed" if error is not None else "serve_completed"
+                )
+            self._update_gauges_locked()
+            self._cv.notify_all()
 
     # -- execution -----------------------------------------------------------
 
@@ -729,12 +944,46 @@ class RunService:
         self.metrics.inc(
             "serve_exec_cache_hits" if hit else "serve_exec_cache_misses"
         )
-        return compiled
+        return compiled, hit
+
+    def _record_execute(self, job: Job, start: float, checker,
+                        cache_hit: bool, span_id: Optional[str] = None,
+                        attach_phases: bool = True, **extra: Any) -> None:
+        """One "execute" span per attempt, with the engine's phase
+        timers attached as children — how device time shows up in the
+        job waterfall without the engines knowing about serve. Solo runs
+        pass `span_id` (pre-assigned, handed to the engine as its span
+        parent) and `attach_phases=False`: the engine itself recorded
+        its run/era/phase spans under it."""
+        end = time.time()
+        span = self.spans.record(
+            "execute",
+            start=start,
+            end=end,
+            trace_id=job.trace_id,
+            span_id=span_id,
+            parent_id=job.root_span_id,
+            attributes={
+                "job_id": job.id,
+                "engine": job.engine,
+                "attempt": job.attempts,
+                "cache": "hit" if cache_hit else "miss",
+                **extra,
+            },
+        )
+        if attach_phases:
+            phase_ms = (checker.telemetry() or {}).get("phase_ms") or {}
+            attach_phase_spans(
+                self.spans, phase_ms,
+                trace_id=job.trace_id, parent_id=span["span_id"], end=end,
+                attributes={"job_id": job.id},
+            )
 
     def _run_multiplex_batch(self, jobs: List[Job]) -> None:
         from ..engines.multiplex import run_multiplexed
 
-        compiled = self._cache_get(
+        exec_t0 = time.time()
+        compiled, hit = self._cache_get(
             jobs[0].model, "multiplex", self.lane_options
         )
         builders = []
@@ -747,6 +996,9 @@ class RunService:
         for j, checker in zip(jobs, checkers):
             j.result = self._result_payload(j, checker)
             self.metrics.inc("serve_multiplexed_jobs")
+            self._record_execute(
+                j, exec_t0, checker, hit, lanes=len(jobs),
+            )
         self.metrics.inc(
             "serve_batches",
             (len(jobs) + self.lanes - 1) // self.lanes,
@@ -754,18 +1006,36 @@ class RunService:
         self._finish(jobs)
 
     def _run_solo(self, job: Job) -> None:
+        exec_t0 = time.time()
+        # Pre-assigned execute-span id: the engine parents its own
+        # run/era/phase spans to it while executing; the span itself is
+        # sealed after the join.
+        exec_span_id = new_span_id()
         if job.engine == "tpu_bfs":
-            compiled = self._cache_get(job.model, "tpu_bfs", self.solo_options)
+            compiled, hit = self._cache_get(
+                job.model, "tpu_bfs", self.solo_options
+            )
             builder = compiled.builder()
             if job.options.get("target_max_depth"):
                 builder.target_max_depth(job.options["target_max_depth"])
+            builder.spans(
+                self.spans, trace_id=job.trace_id, parent_id=exec_span_id
+            )
             checker = compiled.spawn(builder).join()
         else:  # host bfs
+            hit = False
             builder = job.model.checker()
             if job.options.get("target_max_depth"):
                 builder.target_max_depth(job.options["target_max_depth"])
+            builder.spans(
+                self.spans, trace_id=job.trace_id, parent_id=exec_span_id
+            )
             checker = builder.spawn_bfs().join()
         job.result = self._result_payload(job, checker)
+        self._record_execute(
+            job, exec_t0, checker, hit,
+            span_id=exec_span_id, attach_phases=False,
+        )
         self._finish([job])
 
     def _result_payload(self, job: Job, checker) -> Dict[str, Any]:

@@ -388,3 +388,126 @@ def test_result_gc_prunes_jobs_and_journal(tmp_path):
         assert JobJournal.replay(svc._journal.path) == {}  # and the WAL
     finally:
         svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Trace continuity (PR 12): one trace per job, across crashes and retries
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_survives_journal_replay(tmp_path):
+    svc = _svc(tmp_path)
+    svc.pause()
+    code, body = svc.submit({"spec": "increment:2", "engine": "bfs"})
+    assert code == 202
+    jid, tid = body["job_id"], body["trace_id"]
+    assert len(tid) == 32
+    root = svc.job(jid).root_span_id
+    svc.shutdown()  # crash with the job still queued
+
+    svc2 = _svc(tmp_path)
+    try:
+        job = svc2.job(jid)
+        # Identity restored from the journal, not regenerated.
+        assert job.trace_id == tid
+        assert job.root_span_id == root
+        done = _wait(svc2, jid)
+        assert done.status == "done", done.error
+
+        trace = svc2.spans.trace(tid)
+        names = [s["name"] for s in trace]
+        # The restart is visible IN the original trace, followed by the
+        # post-restart lifecycle — one continuous waterfall.
+        assert "restart_recovery" in names
+        for leg in ("queue_wait", "execute", "job"):
+            assert leg in names, names
+        assert all(s["trace_id"] == tid for s in trace)
+        (recovery,) = [s for s in trace if s["name"] == "restart_recovery"]
+        assert recovery["parent_id"] == root
+        (root_span,) = [s for s in trace if s["name"] == "job"]
+        assert root_span["span_id"] == root
+        assert root_span["attributes"]["final_status"] == "done"
+    finally:
+        svc2.shutdown()
+
+
+def test_retry_backoff_and_reexecution_share_the_trace(tmp_path):
+    svc = _svc(tmp_path)
+    orig = svc._run_solo
+    blown = []
+
+    def flaky(job):
+        if not blown:
+            blown.append(job.id)
+            raise RuntimeError(
+                "visited-table probe budget exhausted despite headroom"
+            )
+        orig(job)
+
+    svc._run_solo = flaky
+    try:
+        code, body = svc.submit({"spec": "increment:2", "engine": "bfs"})
+        assert code == 202
+        job = _wait(svc, body["job_id"])
+        assert job.status == "done", job.error
+        assert job.attempts == 2
+
+        trace = svc.spans.trace(job.trace_id)
+        executes = [s for s in trace if s["name"] == "execute"]
+        # Attempt 1 (failed) AND attempt 2 (succeeded) are both spans of
+        # the SAME trace, each tagged with its attempt number.
+        assert len(executes) == 2, [s["name"] for s in trace]
+        by_attempt = {s["attributes"]["attempt"]: s for s in executes}
+        assert by_attempt[1]["status"] == "error"
+        assert "probe budget" in by_attempt[1]["attributes"]["error"]
+        assert by_attempt[2]["status"] == "ok"
+        # The backoff window between them is a span too.
+        (backoff,) = [s for s in trace if s["name"] == "backoff_wait"]
+        assert backoff["attributes"]["attempt"] == 1
+        assert by_attempt[1]["end"] <= backoff["end"] <= by_attempt[2]["start"]
+        # Two queue waits: the original admission and the re-enqueue.
+        queue_waits = [s for s in trace if s["name"] == "queue_wait"]
+        assert len(queue_waits) == 2
+        (root_span,) = [s for s in trace if s["name"] == "job"]
+        assert root_span["attributes"]["attempts"] == 2
+    finally:
+        svc.shutdown()
+
+
+def test_escalation_links_multiplex_and_solo_executions(tmp_path):
+    svc = _svc(tmp_path)
+
+    def lane_wall(jobs):
+        raise RuntimeError(
+            "lane 0 did not complete within the lane budget (frontier=9, "
+            "unique=65000); raise queue_capacity/table_capacity or run it "
+            "solo via spawn_tpu_bfs"
+        )
+
+    svc._run_multiplex_batch = lane_wall
+    try:
+        code, body = svc.submit({"spec": "increment:2"})  # auto -> multiplex
+        assert code == 202
+        job = _wait(svc, body["job_id"])
+        assert job.status == "done", job.error
+        assert job.engine == "tpu_bfs"
+
+        trace = svc.spans.trace(job.trace_id)
+        executes = sorted(
+            (s for s in trace if s["name"] == "execute"),
+            key=lambda s: s["attributes"]["attempt"],
+        )
+        # The failed lane attempt and the solo re-run are siblings under
+        # one root: the escalation reads straight off the waterfall.
+        assert len(executes) == 2
+        assert executes[0]["status"] == "error"
+        assert executes[0]["attributes"]["engine"] == "multiplex"
+        assert executes[1]["status"] == "ok"
+        assert executes[1]["attributes"]["engine"] == "tpu_bfs"
+        assert executes[0]["trace_id"] == executes[1]["trace_id"]
+        (root_span,) = [s for s in trace if s["name"] == "job"]
+        assert all(s["parent_id"] == root_span["span_id"] for s in executes)
+        (backoff,) = [s for s in trace if s["name"] == "backoff_wait"]
+        assert backoff["attributes"]["next_engine"] == "tpu_bfs"
+    finally:
+        svc.shutdown()

@@ -1,0 +1,444 @@
+"""Run-ledger span tests: the recorder primitive (obs/spans.py), the
+latency histogram (obs/metrics.py), the structured logger (obs/log.py),
+engine span wiring, Chrome/OTel exports, and the servers' /events SSE
+stream. Serve-side trace *continuity* across crashes/retries lives in
+tests/test_serve_durability.py.
+"""
+
+import json
+import queue
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from stateright_tpu.models.fixtures import BinaryClock
+from stateright_tpu.obs.log import configure, get_logger
+from stateright_tpu.obs.metrics import Histogram, MetricsRegistry, render_prometheus
+from stateright_tpu.obs.spans import (
+    SpanRecorder,
+    attach_phase_spans,
+    new_span_id,
+    new_trace_id,
+    spans_to_chrome,
+)
+
+
+# ---------------------------------------------------------------------------
+# SpanRecorder primitive
+# ---------------------------------------------------------------------------
+
+
+def test_ids_are_otel_width_hex():
+    t, s = new_trace_id(), new_span_id()
+    assert len(t) == 32 and int(t, 16) >= 0
+    assert len(s) == 16 and int(s, 16) >= 0
+
+
+def test_record_and_trace_query_sorted_by_start():
+    rec = SpanRecorder()
+    tid = new_trace_id()
+    rec.record("b", start=2.0, end=3.0, trace_id=tid)
+    rec.record("a", start=1.0, end=4.0, trace_id=tid)
+    rec.record("other", start=0.0, end=1.0)  # different trace
+    trace = rec.trace(tid)
+    assert [s["name"] for s in trace] == ["a", "b"]
+    assert len(rec.spans()) == 3
+    assert len(rec.spans(tid)) == 2
+    assert rec.trace_ids()[-1] == tid or tid in rec.trace_ids()
+
+
+def test_record_clamps_negative_durations():
+    rec = SpanRecorder()
+    span = rec.record("x", start=5.0, end=4.0)
+    assert span["end"] == span["start"] == 5.0
+
+
+def test_capacity_bounds_the_ledger():
+    rec = SpanRecorder(capacity=4)
+    for i in range(10):
+        rec.record(f"s{i}", start=float(i), end=float(i) + 0.5)
+    names = [s["name"] for s in rec.spans()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_open_span_context_manager_and_events():
+    rec = SpanRecorder()
+    tid = new_trace_id()
+    with rec.start_span("op", trace_id=tid, attributes={"k": 1}) as span:
+        span.add_event("milestone", detail="halfway")
+    (s,) = rec.spans(tid)
+    assert s["status"] == "ok" and s["attributes"]["k"] == 1
+    assert s["events"][0]["name"] == "milestone"
+    assert s["end"] >= s["start"]
+
+
+def test_open_span_records_error_status_on_exception():
+    rec = SpanRecorder()
+    tid = new_trace_id()
+    with pytest.raises(RuntimeError):
+        with rec.start_span("boom", trace_id=tid):
+            raise RuntimeError("kaput")
+    (s,) = rec.spans(tid)
+    assert s["status"] == "error"
+    assert "kaput" in s["attributes"]["error"]
+
+
+def test_subscriber_feed_receives_completions_and_drops_when_full():
+    rec = SpanRecorder()
+    sub = rec.subscribe(maxsize=2)
+    rec.record("one", start=1.0, end=2.0)
+    assert sub.get_nowait()["name"] == "one"
+    rec.record("a", start=1.0, end=2.0)
+    rec.record("b", start=1.0, end=2.0)
+    rec.record("dropped", start=1.0, end=2.0)  # full queue: must not block
+    got = [sub.get_nowait()["name"], sub.get_nowait()["name"]]
+    assert got == ["a", "b"]
+    with pytest.raises(queue.Empty):
+        sub.get_nowait()
+    rec.unsubscribe(sub)
+    rec.record("after", start=1.0, end=2.0)
+    with pytest.raises(queue.Empty):
+        sub.get_nowait()
+
+
+def test_metrics_registry_counts_recorded_spans():
+    m = MetricsRegistry()
+    rec = SpanRecorder(metrics=m)
+    rec.record("x", start=1.0, end=2.0)
+    rec.record("y", start=1.0, end=2.0)
+    assert m.snapshot()["spans_recorded"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Exports: OTel JSONL + Chrome trace events
+# ---------------------------------------------------------------------------
+
+
+def test_otel_jsonl_export_shape(tmp_path):
+    rec = SpanRecorder()
+    tid = new_trace_id()
+    root = new_span_id()
+    rec.record("parent", start=1.0, end=2.0, trace_id=tid, span_id=root,
+               attributes={"job": "j1"})
+    rec.record("child", start=1.2, end=1.8, trace_id=tid, parent_id=root,
+               status="error")
+    path = tmp_path / "spans.jsonl"
+    assert rec.export_jsonl(str(path)) == 2
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    parent, child = rows
+    assert parent["traceId"] == tid and parent["spanId"] == root
+    assert parent["parentSpanId"] == ""
+    assert parent["startTimeUnixNano"] == int(1.0 * 1e9)
+    assert parent["status"] == {"code": "OK"}
+    assert parent["attributes"] == [
+        {"key": "job", "value": {"stringValue": "j1"}}
+    ]
+    assert child["parentSpanId"] == root
+    assert child["status"] == {"code": "ERROR"}
+
+
+def test_chrome_export_balanced_and_nested(tmp_path):
+    rec = SpanRecorder()
+    tid = new_trace_id()
+    # Same start: the longer (outer) span must open first and close last.
+    rec.record("inner", start=1.0, end=1.5, trace_id=tid)
+    rec.record("outer", start=1.0, end=2.0, trace_id=tid)
+    path = tmp_path / "trace.json"
+    assert rec.export_chrome(str(path)) == 4
+    events = json.loads(path.read_text())
+    assert [(e["name"], e["ph"]) for e in events] == [
+        ("outer", "B"), ("inner", "B"), ("inner", "E"), ("outer", "E"),
+    ]
+    begins = [e for e in events if e["ph"] == "B"]
+    ends = [e for e in events if e["ph"] == "E"]
+    assert len(begins) == len(ends) == 2
+    assert all(e["tid"] == f"trace:{tid[:8]}" for e in events)
+    assert begins[0]["args"]["trace_id"] == tid
+
+
+def test_spans_to_chrome_ends_before_begins_at_ties():
+    tid = new_trace_id()
+    spans = [
+        {"name": "first", "trace_id": tid, "span_id": new_span_id(),
+         "parent_id": None, "start": 1.0, "end": 2.0, "status": "ok"},
+        {"name": "second", "trace_id": tid, "span_id": new_span_id(),
+         "parent_id": None, "start": 2.0, "end": 3.0, "status": "ok"},
+    ]
+    events = spans_to_chrome(spans)
+    # At ts=2.0 the E of "first" must precede the B of "second".
+    assert [(e["name"], e["ph"]) for e in events] == [
+        ("first", "B"), ("first", "E"), ("second", "B"), ("second", "E"),
+    ]
+
+
+def test_attach_phase_spans_widths_and_alignment():
+    rec = SpanRecorder()
+    tid, parent = new_trace_id(), new_span_id()
+    made = attach_phase_spans(
+        rec,
+        {"device_era": 100.0, "readback": 25.0, "idle": 0.0},
+        trace_id=tid, parent_id=parent, end=10.0,
+        attributes={"engine": "X"},
+    )
+    assert [s["name"] for s in made] == ["phase:device_era", "phase:readback"]
+    for s in made:
+        assert s["end"] == 10.0 and s["parent_id"] == parent
+        assert s["attributes"]["engine"] == "X"
+    era = made[0]
+    assert era["end"] - era["start"] == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# Histogram + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantiles_and_buckets():
+    h = Histogram()
+    for v in [0.001, 0.002, 0.004, 0.008, 0.5]:
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.515)
+    assert 0.0 < h.quantile(0.5) <= 0.008
+    # The top quantile clamps to the observed max, not a bucket bound.
+    assert h.quantile(0.99) <= 0.5
+    buckets = h.buckets()
+    assert buckets[-1][0] == float("inf") and buckets[-1][1] == 5
+    # Cumulative counts never decrease.
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts)
+    snap = h.snapshot()
+    assert snap["count"] == 5 and "p99" in snap and "p50" in snap
+    assert snap["buckets"][-1][0] == "+Inf"
+
+
+def test_histogram_empty_is_sane():
+    h = Histogram()
+    assert h.count == 0 and h.quantile(0.99) == 0.0
+    assert h.snapshot()["p50"] == 0.0
+
+
+def test_registry_histogram_rides_snapshot_and_prometheus():
+    m = MetricsRegistry()
+    m.observe("submit_to_result_secs", 0.004)
+    m.observe("submit_to_result_secs", 0.1)
+    snap = m.snapshot()
+    hist = snap["histograms"]["submit_to_result_secs"]
+    assert hist["count"] == 2
+    text = render_prometheus(snap)
+    assert 'submit_to_result_secs_bucket{le="+Inf"} 2' in text
+    assert "submit_to_result_secs_count 2" in text
+    assert "submit_to_result_secs_sum" in text
+    assert "# TYPE stateright_submit_to_result_secs histogram" in text
+
+
+# ---------------------------------------------------------------------------
+# Structured logger
+# ---------------------------------------------------------------------------
+
+
+def test_logger_threshold_and_list_sink():
+    records = []
+    configure(level="info", sink=records)
+    try:
+        log = get_logger("test.component")
+        log.debug("too quiet", x=1)
+        log.info("hello", trace_id="abc123")
+        log.error("bad", code=7)
+        assert [r["msg"] for r in records] == ["hello", "bad"]
+        assert records[0]["component"] == "test.component"
+        assert records[0]["level"] == "info"
+        assert records[0]["trace_id"] == "abc123"
+        assert records[1]["code"] == 7
+        assert all("ts" in r for r in records)
+    finally:
+        configure()  # reset to env-driven defaults
+
+
+def test_logger_force_bypasses_threshold():
+    records = []
+    configure(level="off", sink=records)
+    try:
+        get_logger("gated").force("debug", "explicitly requested", n=1)
+        assert len(records) == 1 and records[0]["level"] == "debug"
+    finally:
+        configure()
+
+
+def test_logger_records_are_json_lines(tmp_path):
+    path = tmp_path / "log.jsonl"
+    configure(level="warning", sink=str(path))
+    try:
+        get_logger("c").warning("to file", k="v")
+        rec = json.loads(path.read_text().splitlines()[0])
+        assert rec["msg"] == "to file" and rec["k"] == "v"
+    finally:
+        configure()
+
+
+def test_configure_rejects_unknown_level():
+    with pytest.raises(ValueError, match="unknown log level"):
+        configure(level="loud")
+    configure()
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring: CheckerBuilder.spans()
+# ---------------------------------------------------------------------------
+
+
+def test_host_engine_records_run_span_with_phases():
+    rec = SpanRecorder()
+    checker = BinaryClock().checker().spans(rec).spawn_bfs().join()
+    assert checker.is_done()
+    tids = rec.trace_ids()
+    assert len(tids) == 1
+    trace = rec.trace(tids[0])
+    runs = [s for s in trace if s["name"] == "run"]
+    assert len(runs) == 1
+    run = runs[0]
+    assert run["parent_id"] is None and run["status"] == "ok"
+    assert run["attributes"]["states"] == checker.state_count()
+    # Engine phase timers become child spans under the run span.
+    phases = [s for s in trace if s["name"].startswith("phase:")]
+    assert phases and all(s["parent_id"] == run["span_id"] for s in phases)
+    # Progress spans (waves) parent into the run span too.
+    waves = [s for s in trace if s["name"] == "wave"]
+    assert waves and all(s["parent_id"] == run["span_id"] for s in waves)
+
+
+def test_engine_span_ids_flow_from_builder():
+    rec = SpanRecorder()
+    tid, parent = new_trace_id(), new_span_id()
+    BinaryClock().checker().spans(rec, trace_id=tid, parent_id=parent) \
+        .spawn_bfs().join()
+    trace = rec.trace(tid)
+    assert trace, "engine must record into the provided trace"
+    (run,) = [s for s in trace if s["name"] == "run"]
+    assert run["trace_id"] == tid and run["parent_id"] == parent
+
+
+def test_chrome_trace_embeds_spans(tmp_path):
+    # Satellite: .trace(path, format="chrome") + .spans() => ONE Perfetto
+    # file carrying engine phases AND the run's spans on aligned clocks.
+    path = tmp_path / "run.chrome.json"
+    rec = SpanRecorder()
+    BinaryClock().checker().trace(str(path), format="chrome") \
+        .spans(rec).spawn_bfs().join()
+    events = json.loads(path.read_text())
+    names = {e.get("name") for e in events}
+    assert "run" in names, "span events must be embedded in the trace file"
+    span_events = [e for e in events if "trace_id" in (e.get("args") or {})]
+    begins = sum(1 for e in span_events if e["ph"] == "B")
+    span_names = {s["name"] for s in rec.spans()}
+    ends = sum(
+        1 for e in events
+        if e.get("ph") == "E" and e.get("name") in span_names
+    )
+    assert begins and begins == ends
+
+
+# ---------------------------------------------------------------------------
+# /events SSE stream (Explorer; the serve server shares the handler)
+# ---------------------------------------------------------------------------
+
+
+def _sse_blocks(url):
+    raw = urllib.request.urlopen(url).read().decode()
+    return [b for b in raw.strip().split("\n\n") if b]
+
+
+def test_explorer_events_stream_spans_and_metric_deltas():
+    from stateright_tpu.explorer.server import serve
+
+    server = serve(BinaryClock().checker(), "127.0.0.1:0", block=False)
+    try:
+        base = server.url.rstrip("/")
+        server.checker.run_to_completion()
+        server.checker.join()
+        blocks = _sse_blocks(f"{base}/events?replay=50&limit=5&duration=4")
+        spans = [json.loads(b.split("data: ", 1)[1]) for b in blocks
+                 if b.startswith("event: span")]
+        assert spans, blocks
+        assert "run" in {s["name"] for s in spans}
+        metrics = [json.loads(b.split("data: ", 1)[1]) for b in blocks
+                   if b.startswith("event: metrics")]
+        assert metrics and all("changed" in m for m in metrics)
+        # Limit bounds the span count even with a bigger replay buffer.
+        assert len(spans) <= 5
+    finally:
+        server.shutdown()
+
+
+def test_explorer_ui_ships_waterfall_panel():
+    from stateright_tpu.explorer.server import serve
+
+    server = serve(BinaryClock().checker(), "127.0.0.1:0", block=False)
+    try:
+        base = server.url.rstrip("/")
+        html = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "spans-panel" in html and 'id="waterfall"' in html
+        js = urllib.request.urlopen(f"{base}/app.js").read().decode()
+        assert "EventSource" in js and "startSpanStream" in js
+        css = urllib.request.urlopen(f"{base}/app.css").read().decode()
+        assert ".wf-bar" in css
+        server.checker.run_to_completion()
+        server.checker.join()
+    finally:
+        server.shutdown()
+
+
+def test_serve_events_and_job_trace_endpoint():
+    from stateright_tpu.serve import RunService, ServeServer
+
+    svc = RunService(workers=1, lint_samples=32)
+    server = ServeServer(svc, "127.0.0.1:0").serve_in_background()
+    try:
+        base = server.url.rstrip("/")
+        req = urllib.request.Request(
+            base + "/submit",
+            data=json.dumps({"spec": "increment:2", "engine": "bfs"}).encode(),
+        )
+        body = json.load(urllib.request.urlopen(req))
+        jid, tid = body["job_id"], body["trace_id"]
+        assert len(tid) == 32
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            view = json.load(urllib.request.urlopen(f"{base}/jobs/{jid}"))
+            if view["status"] not in ("queued", "running"):
+                break
+            time.sleep(0.05)
+        assert view["status"] == "done", view
+        assert view["trace_id"] == tid
+
+        ledger = json.load(urllib.request.urlopen(f"{base}/jobs/{jid}/trace"))
+        assert ledger["trace_id"] == tid
+        names = [s["name"] for s in ledger["spans"]]
+        for expected in ("admission", "queue_wait", "execute", "job"):
+            assert expected in names, names
+        (root,) = [s for s in ledger["spans"] if s["name"] == "job"]
+        assert root["parent_id"] is None
+        assert root["attributes"]["final_status"] == "done"
+        # Every other span hangs off the job's trace; lifecycle legs
+        # parent to the root.
+        for s in ledger["spans"]:
+            if s["name"] in ("admission", "queue_wait", "execute"):
+                assert s["parent_id"] == root["span_id"], s
+
+        blocks = _sse_blocks(f"{base}/events?replay=20&limit=4&duration=4")
+        spans = [json.loads(b.split("data: ", 1)[1]) for b in blocks
+                 if b.startswith("event: span")]
+        assert spans, blocks
+
+        stats = json.load(urllib.request.urlopen(f"{base}/stats"))
+        lat = stats["latency"]["submit_to_result"]
+        assert lat["count"] >= 1 and lat["p99"] > 0.0
+        assert set(lat) >= {"count", "p50", "p95", "p99"}
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/jobs/nope/trace")
+        assert err.value.code == 404
+    finally:
+        server.shutdown()
