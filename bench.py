@@ -60,6 +60,21 @@ Round 6 additions:
     the number behind README's "true parallelism beyond the GIL".
   - `single-copy-register check 4` run exhaustively (host oracle +
     device twin, golden-matched) instead of only the 3x2 TTFC line.
+
+Perf history + regression gate:
+  - `--history FILE` appends one compact summary row (JSONL: section
+    rates, medians, latency quantiles, instrumentation overheads) after
+    the run, so FILE accumulates a rolling perf record across rounds.
+  - `--gate FILE` compares the run against the rolling baseline (the
+    per-metric median of the last 5 history rows) and exits nonzero on
+    any regression beyond budget (rates -15%; latency/overheads +25%
+    with an absolute noise floor). The gate evaluates BEFORE the
+    history append so a regressed run never poisons its own baseline.
+  - `--from BENCH.json` applies either flag to a prior record with no
+    device run and no jax import (CI's cheap path); `--smoke` runs a
+    tiny 2pc-5 device workload instead of the full bench — the CI
+    perf-gate smoke stage uses it, with BENCH_PERTURB_SLEEP (secs)
+    injecting deliberate degradation to prove the gate trips.
 """
 
 import json
@@ -217,12 +232,20 @@ def print_stage_table(phase_ms, us_per_step=None, out=None):
 
 
 def timed3(mk_checker, golden=None, check=None):
-    """Run a device workload 3x warm; return (median_secs, spread, last)."""
+    """Run a device workload 3x warm; return (median_secs, spread, last).
+
+    BENCH_PERTURB_SLEEP (secs, float) injects a sleep INSIDE the timing
+    window of every run — the deliberate-degradation knob the perf-gate
+    smoke stage uses to prove `--gate` actually trips (ci.sh).
+    """
+    perturb = float(os.environ.get("BENCH_PERTURB_SLEEP", "0") or 0.0)
     secs = []
     last = None
     for _ in range(3):
         t0 = time.perf_counter()
         last = mk_checker().join()
+        if perturb > 0.0:
+            time.sleep(perturb)
         secs.append(time.perf_counter() - t0)
         if golden is not None:
             assert last.unique_state_count() == golden, (
@@ -332,10 +355,184 @@ def compare_bench(path_a, path_b, out=None):
         out.write(
             f"{k:<{name_w}}  {fmt(va):>14}  {fmt(vb):>14}  {delta:>12}  {pct:>8}\n"
         )
+
+    def _focus(title, selected, unit=""):
+        rows = [k for k in keys if selected(k)]
+        if not rows:
+            return
+        out.write(f"\n{title}:\n")
+        for k in rows:
+            va, vb = fa.get(k), fb.get(k)
+            pct = (
+                f"{(vb - va) / va * 100.0:+.1f}%"
+                if va not in (None, 0) and vb is not None
+                else "-"
+            )
+            out.write(
+                f"  {k:<{name_w}}  {fmt(va):>12}{unit}  ->"
+                f"  {fmt(vb):>12}{unit}  {pct:>8}\n"
+            )
+
+    # Focused recaps of the observability sections so a review doesn't
+    # have to fish them out of the flat dump: latency-histogram quantile
+    # shifts, and the instrumented-overhead percentages (span ledger,
+    # checkpointing, flight recorder).
+    _focus(
+        "latency quantiles (secs)",
+        lambda k: ".latency." in k
+        and k.rsplit(".", 1)[-1] in ("p50", "p95", "p99"),
+    )
+    _focus(
+        "instrumentation overhead (pct of device rate)",
+        lambda k: k.endswith("overhead_pct"),
+    )
     return 0
 
 
-def main() -> None:
+# -- perf history + regression gate (`--history FILE` / `--gate FILE`) --------
+#
+# `--history FILE` appends one compact summary row (JSONL) per bench run;
+# `--gate FILE` compares the current run against the rolling baseline —
+# the per-metric median of the last GATE_BASELINE_WINDOW history rows —
+# and exits nonzero on any regression beyond the metric's budget.
+# Both accept `--from BENCH.json` to operate on a prior record without a
+# device run (and without importing jax): CI's cheap path.
+
+GATE_BASELINE_WINDOW = 5
+
+# Direction inference by metric-name fragment. Higher-better: throughput
+# rates and speedups. Lower-better: wall times, latency quantiles, and
+# instrumentation overheads. Keys matching neither stay out of the gate.
+_GATE_HIGHER = ("states_per_sec", "checks_per_sec", "per_sec", "speedup")
+_GATE_LOWER = ("p50", "p95", "p99", "secs", "ms", "overhead_pct")
+
+# Sections whose numeric leaves are environment/diagnostic detail, not
+# performance contracts — excluded from the gated summary.
+_GATE_EXCLUDE = (
+    ".telemetry.",
+    ".coverage.",
+    ".speclint.",
+    ".roofline.",
+    ".stage_profile.",
+    ".flight.",
+)
+
+
+def _gate_direction(key):
+    if key == "value":  # the headline states/sec
+        return "higher"
+    leaf = key.rsplit(".", 1)[-1]
+    for frag in _GATE_HIGHER:
+        if frag in leaf:
+            return "higher"
+    for frag in _GATE_LOWER:
+        if frag in leaf:
+            return "lower"
+    return None
+
+
+def bench_summary(record):
+    """Compact, gate-relevant flat summary of one BENCH record: section
+    rates and medians, latency quantiles, instrumentation overheads.
+    This is the JSONL row ``--history`` appends and ``--gate`` compares."""
+    flat = {}
+    _flatten_metrics("", record, flat)
+    return {
+        key: value
+        for key, value in sorted(flat.items())
+        if not any(frag in key for frag in _GATE_EXCLUDE)
+        and _gate_direction(key) is not None
+    }
+
+
+def load_history(path):
+    """History rows (list of dicts), oldest first; [] when missing."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def append_history(path, record):
+    summary = bench_summary(record)
+    with open(path, "a") as f:
+        f.write(json.dumps(summary, sort_keys=True) + "\n")
+    return summary
+
+
+def _gate_check(key, base, cur):
+    """None when `cur` is within budget of `base`, else a reason string.
+
+    Rates get a 15% budget; latency/overhead metrics get 25% plus an
+    absolute floor (0.05s-equivalent; 1.0 percentage point for
+    `overhead_pct`) so near-zero baselines don't trip on noise.
+    """
+    if base <= 0:
+        return None
+    if _gate_direction(key) == "higher":
+        if cur < base * (1.0 - 0.15):
+            return f"{(cur / base - 1.0) * 100.0:+.1f}% (budget -15%)"
+        return None
+    floor = 1.0 if key.endswith("overhead_pct") else 0.05
+    if cur > base * (1.0 + 0.25) and cur - base > floor:
+        return f"{(cur / base - 1.0) * 100.0:+.1f}% (budget +25%)"
+    return None
+
+
+def gate_bench(history_path, record, out=None):
+    """Exit code for the perf gate: 0 when every metric shared with the
+    rolling baseline (median of the last GATE_BASELINE_WINDOW history
+    rows) is within budget, 1 on any regression. An empty or missing
+    history passes — the first run seeds the baseline."""
+    out = out if out is not None else sys.stdout
+    rows = load_history(history_path)
+    if not rows:
+        out.write(f"perf gate: no history at {history_path} — pass (seed run)\n")
+        return 0
+    window = rows[-GATE_BASELINE_WINDOW:]
+    summary = bench_summary(record)
+    checked = 0
+    regressions = []
+    for key, cur in summary.items():
+        base_vals = [
+            row[key]
+            for row in window
+            if isinstance(row.get(key), (int, float))
+            and not isinstance(row.get(key), bool)
+        ]
+        if not base_vals:
+            continue
+        checked += 1
+        base = statistics.median(base_vals)
+        reason = _gate_check(key, base, float(cur))
+        if reason is not None:
+            regressions.append((key, base, float(cur), reason))
+    for key, base, cur, reason in regressions:
+        out.write(
+            f"perf gate: REGRESSION {key}: baseline {base:g} -> {cur:g} "
+            f"[{reason}]\n"
+        )
+    out.write(
+        f"perf gate: {checked} metrics vs median of last {len(window)} "
+        f"run(s): {'FAIL' if regressions else 'ok'} "
+        f"({len(regressions)} regression(s))\n"
+    )
+    return 1 if regressions else 0
+
+
+def main() -> int:
     if "--compare" in sys.argv:
         i = sys.argv.index("--compare")
         try:
@@ -372,6 +569,35 @@ def main() -> None:
         print(json.dumps({"roofline": rep}))
         return 0
 
+    def _flag_value(flag):
+        if flag in sys.argv:
+            i = sys.argv.index(flag)
+            if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("-"):
+                raise SystemExit(f"usage: python bench.py {flag} FILE")
+            return sys.argv[i + 1]
+        return None
+
+    history_path = _flag_value("--history")
+    gate_path = _flag_value("--gate")
+    from_path = _flag_value("--from")
+
+    def _gate_and_record(record):
+        # Gate BEFORE appending: a regressed run must not poison the
+        # baseline it was judged against.
+        code = gate_bench(gate_path, record) if gate_path else 0
+        if history_path:
+            append_history(history_path, record)
+        return code
+
+    if from_path is not None:
+        # Operate on a prior BENCH record — no device run, no jax import.
+        if not (history_path or gate_path):
+            raise SystemExit(
+                "usage: python bench.py --from BENCH.json "
+                "[--history FILE] [--gate FILE]"
+            )
+        return _gate_and_record(_load_bench(from_path))
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -380,6 +606,40 @@ def main() -> None:
     from stateright_tpu import TensorModelAdapter
     from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
     from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+    if "--smoke" in sys.argv:
+        # Tiny device workload (2pc-5) emitting the standard BENCH json —
+        # just enough signal for the CI perf-gate smoke stage to exercise
+        # --history/--gate end-to-end without the full bench's runtime.
+        tm5s = TwoPhaseTensor(5)
+        smoke_opts = dict(
+            chunk_size=512, queue_capacity=1 << 13, table_capacity=1 << 14
+        )
+        TensorModelAdapter(tm5s).checker().spawn_tpu_bfs(
+            **smoke_opts
+        ).join()  # compile
+        med5s, _spread5s, dev5s = timed3(
+            lambda: TensorModelAdapter(tm5s).checker().spawn_tpu_bfs(
+                **smoke_opts
+            ),
+            golden=8_832,
+        )
+        rate5s = dev5s.state_count() / med5s
+        record = {
+            "metric": "2pc-5 smoke, generated states/sec "
+            "(device engine, median of 3)",
+            "value": round(rate5s, 1),
+            "unit": "states/sec",
+            "detail": {
+                "tpc5_smoke": {
+                    "states_per_sec": round(rate5s, 1),
+                    "secs_median": round(med5s, 3),
+                    "unique": dev5s.unique_state_count(),
+                }
+            },
+        }
+        print(json.dumps(record), flush=True)
+        return _gate_and_record(record)
 
     detail = {}
     result = {}
@@ -572,6 +832,44 @@ def main() -> None:
     }
     assert saves >= 1, "checkpoint cadence never fired during the bench"
     assert ckpt_overhead_pct < 5.0, detail["tpc7_checkpoint_cost"]
+
+    # Flight-recorder cost: the headline runs above record a flight by
+    # default, so the control is the same workload with .flight(False).
+    # Every flight field comes from the once-per-era packed-params
+    # readback plus host clocks — zero extra device round-trips —
+    # (acceptance: recording costs < 2%, and the per-era device/host-gap
+    # wall split reconciles with an externally timed run within 5%).
+    TensorModelAdapter(tm7).checker().flight(False).spawn_tpu_bfs(
+        **opts
+    ).join()  # compile
+    med7fl, _spread7fl, dev7fl = timed3(
+        lambda: (
+            TensorModelAdapter(tm7).checker().flight(False)
+            .spawn_tpu_bfs(**opts)
+        ),
+        golden=tpc7_golden,
+    )
+    rate_fl_off = dev7fl.state_count() / med7fl
+    flight_overhead_pct = (1.0 - dev_rate / rate_fl_off) * 100.0
+    t0 = time.perf_counter()
+    recon7 = TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts).join()
+    recon_wall = time.perf_counter() - t0
+    fsum = recon7.telemetry()["flight"]
+    recon_err_pct = (
+        abs(fsum["device_secs"] + fsum["host_gap_secs"] - recon_wall)
+        / recon_wall
+        * 100.0
+    )
+    detail["tpc7_flight_cost"] = {
+        "states_per_sec_flight_on": round(dev_rate, 1),
+        "states_per_sec_flight_off": round(rate_fl_off, 1),
+        "overhead_pct": round(flight_overhead_pct, 2),
+        "eras": fsum["eras"],
+        "host_gap_pct": fsum["host_gap_pct"],
+        "wall_reconciliation_err_pct": round(recon_err_pct, 2),
+    }
+    assert flight_overhead_pct < 2.0, detail["tpc7_flight_cost"]
+    assert recon_err_pct < 5.0, detail["tpc7_flight_cost"]
 
     # Stage profile: ONE extra run with `.stage_profile()` — kept out of
     # the timed3 window above so the isolated-stage microbenches (a few
@@ -1149,6 +1447,8 @@ def main() -> None:
     )
 
     emit(dev_rate, vs_threaded, partial=any_errors)
+
+    return _gate_and_record(result)
 
 
 if __name__ == "__main__":

@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Eight stages, fail-fast:
+# Nine stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -26,7 +26,11 @@
 #      over the /events SSE stream, histogram _bucket series in
 #      /metrics.prom, and a Chrome-trace export that JSON-parses with
 #      matching B/E pairs,
-#   8. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   8. a perf-gate smoke: `bench.py --smoke` (tiny 2pc-5 device run)
+#      seeds a throwaway history, a parity rerun must pass the gate,
+#      and a BENCH_PERTURB_SLEEP-degraded rerun must trip it — proving
+#      `bench.py --gate` actually fails CI on a real regression,
+#   9. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -281,6 +285,22 @@ print(
     f"{len(ledger['spans'])}-span job ledger, {begins} B/E pairs"
 )
 PY
+
+echo "== perf-gate smoke =="
+gate_tmp="$(mktemp -d /tmp/_gate_smoke.XXXXXX)"
+hist="$gate_tmp/history.jsonl"
+# Seed run: empty history passes the gate and writes the baseline row.
+JAX_PLATFORMS=cpu python bench.py --smoke --gate "$hist" --history "$hist"
+# Parity rerun of the same workload must stay within budget.
+JAX_PLATFORMS=cpu python bench.py --smoke --gate "$hist" --history "$hist"
+# A sleep injected INSIDE the timing window must trip the gate.
+if JAX_PLATFORMS=cpu BENCH_PERTURB_SLEEP=2.5 \
+   python bench.py --smoke --gate "$hist"; then
+  echo "perf-gate smoke FAILED: degraded run passed the gate" >&2
+  exit 1
+fi
+rm -rf "$gate_tmp"
+echo "perf-gate smoke OK: parity passed, degraded run tripped the gate"
 
 echo "== tier-1 tests =="
 set -o pipefail

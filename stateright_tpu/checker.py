@@ -60,6 +60,10 @@ class CheckerBuilder:
         self.span_recorder_: Optional[Any] = None
         self.span_trace_id_: Optional[str] = None
         self.span_parent_id_: Optional[str] = None
+        self.flight_: bool = True
+        self.flight_capacity_: int = 4096
+        self.flight_path_: Optional[str] = None
+        self.flight_format_: str = "jsonl"
 
     # -- options ------------------------------------------------------------
 
@@ -134,6 +138,36 @@ class CheckerBuilder:
         histograms into their era loops, so disabling buys back only a
         few percent of throughput (bench.py records both numbers)."""
         self.coverage_ = enable
+        return self
+
+    def flight(
+        self,
+        enable: bool = True,
+        capacity: int = 4096,
+        path: Optional[str] = None,
+        format: str = "jsonl",
+    ) -> "CheckerBuilder":
+        """Configure the era-granularity flight recorder (obs/flight.py):
+        a bounded ring of per-era records — wall time split into
+        ``device_era`` vs ``host_gap`` (the dispatch gap), states/frontier/
+        table counters — populated from the packed-params readback the
+        device engines already do once per era (zero extra round-trips;
+        <2% overhead, asserted by bench.py). On by default with a
+        `capacity`-record ring; `Checker.flight()` returns the records
+        and ``telemetry()["flight"]`` the summary. `path` additionally
+        exports the recording at run end — JSONL (`format="jsonl"`) or a
+        standalone Chrome counter-track trace (`format="chrome"`); a run
+        traced with ``.trace(p, format="chrome")`` also gets the counter
+        tracks embedded into that trace automatically. Host engines
+        ignore the recorder (they have no era dispatch gap to measure)."""
+        if format not in ("jsonl", "chrome"):
+            raise ValueError(
+                f"unknown flight format {format!r}; available: jsonl, chrome"
+            )
+        self.flight_ = enable
+        self.flight_capacity_ = max(1, int(capacity))
+        self.flight_path_ = path
+        self.flight_format_ = format
         return self
 
     def multiplex_lane(self, enable: bool = True) -> "CheckerBuilder":
@@ -357,6 +391,15 @@ class Checker:
         (`depths`), and per-property evaluation/hit counts
         (`properties`). Engines without coverage support return {}."""
         return {}
+
+    def flight(self) -> List[Dict[str, Any]]:
+        """The engine's flight recording (obs/flight.py): the retained
+        per-era records, oldest first — each splitting the era's wall
+        time into ``device_era_secs`` + ``host_gap_secs`` beside the
+        frontier/table/spill counters read from that era's packed-params
+        readback. The run-level summary rides ``telemetry()["flight"]``.
+        Engines without an era loop return []."""
+        return []
 
     # -- on-demand engine hooks (no-ops elsewhere; checker.rs:298-306) ------
 

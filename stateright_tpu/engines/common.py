@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional
 from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
 from ..obs.coverage import Coverage
+from ..obs.flight import FlightRecorder
 from ..obs.log import get_logger
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import make_trace_writer, start_profile, stop_profile
@@ -92,6 +93,22 @@ class HostEngineBase(Checker):
             else None
         )
         self._profile_dir: Optional[str] = getattr(builder, "profile_dir_", None)
+        # Flight recorder (obs/flight.py): bounded ring of per-era records
+        # — device_era vs host_gap wall split plus frontier/table/spill
+        # counters — fed by each device engine at its existing once-per-era
+        # packed-params readback. Host engines carry the (empty) recorder
+        # too so Checker.flight() and telemetry stay uniform.
+        self._flight = (
+            FlightRecorder(
+                capacity=getattr(builder, "flight_capacity_", 4096),
+                engine=type(self).__name__,
+            )
+            if getattr(builder, "flight_", True)
+            else None
+        )
+        self._flight_path: Optional[str] = getattr(builder, "flight_path_", None)
+        self._flight_format: str = getattr(builder, "flight_format_", "jsonl")
+        self._flight_prev_counters: Dict[str, int] = {}
         # Span ledger (obs/spans.py) via CheckerBuilder.spans(): the whole
         # run becomes one "run" span with phase-timer children; the run
         # span's id is pre-assigned so per-era progress spans can parent to
@@ -170,6 +187,8 @@ class HostEngineBase(Checker):
                 states=int(self._state_count),
                 unique=int(self.unique_state_count()),
             )
+        if self._flight is not None:
+            self._flight.start()
         try:
             self._run()
         except BaseException as e:  # surfaces at join(), like a Rust panic
@@ -186,11 +205,35 @@ class HostEngineBase(Checker):
                     phase_ms=self._metrics.phase_ms(),
                     error=repr(self._error) if self._error else None,
                 )
+            self._flush_flight()
             if self._spans is not None:
                 self._seal_run_span()
             if self._trace is not None:
                 self._trace.close()
             self._done.set()
+
+    def _flush_flight(self) -> None:
+        """At run end: export the flight recording if a path was
+        configured, and append its counter tracks to a Chrome-format run
+        trace so Perfetto lines them up under the phase lanes. Must run
+        before ``self._trace.close()``."""
+        fr = self._flight
+        if fr is None or not len(fr):
+            return
+        if self._flight_path:
+            try:
+                if self._flight_format == "chrome":
+                    fr.export_chrome(self._flight_path)
+                else:
+                    fr.export_jsonl(self._flight_path)
+            except OSError as exc:
+                _log.warning(
+                    "flight export failed",
+                    path=self._flight_path,
+                    error=repr(exc),
+                )
+        if self._trace is not None and hasattr(self._trace, "write_counter_events"):
+            self._trace.write_counter_events(fr.chrome_counter_events())
 
     def _seal_run_span(self) -> None:
         """Record the run span (pre-assigned id, so per-era children are
@@ -276,12 +319,70 @@ class HostEngineBase(Checker):
                     "coverage_dead_actions", len(self._coverage.dead_actions())
                 )
         snap = self._metrics.snapshot()
+        if self._flight is not None:
+            fsum = self._flight.summary()
+            if fsum["eras"]:
+                snap["flight"] = fsum
         snap["engine"] = type(self).__name__
         return snap
 
     def coverage(self) -> Dict[str, Any]:
         """The run's coverage snapshot (obs/coverage.py)."""
         return self._coverage.snapshot()
+
+    def flight(self) -> list:
+        """Retained flight records (obs/flight.py), oldest first. Empty
+        for engines without an era loop or when .flight(False) was set."""
+        return self._flight.records() if self._flight is not None else []
+
+    def _flight_record(
+        self,
+        *,
+        device_era_secs: float,
+        steps: int = 0,
+        generated: int = 0,
+        unique: int = 0,
+        frontier: int = 0,
+        load_factor: float = 0.0,
+        take_cap: int = 0,
+        spill_rows: int = 0,
+        shards: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Append one era to the flight recording (no-op when disabled).
+        Registry counters that move off the hot path (refill/grow/
+        checkpoint) are diffed against the previous era here, so engines
+        don't have to thread per-era volumes through their loops."""
+        fr = self._flight
+        if fr is None:
+            return
+        cur = {
+            name: self._metrics.get(name)
+            for name in ("refill_rows", "table_growths", "checkpoint_saves")
+        }
+        prev = self._flight_prev_counters
+        self._flight_prev_counters = cur
+        rec = fr.record(
+            device_era_secs=device_era_secs,
+            steps=steps,
+            generated=generated,
+            unique=unique,
+            frontier=frontier,
+            load_factor=load_factor,
+            take_cap=take_cap,
+            spill_rows=spill_rows,
+            refill_rows=cur["refill_rows"] - prev.get("refill_rows", 0),
+            table_growths=cur["table_growths"] - prev.get("table_growths", 0),
+            checkpoint_saves=(
+                cur["checkpoint_saves"] - prev.get("checkpoint_saves", 0)
+            ),
+            shards=shards,
+        )
+        # Flat twins of the latest record for Prometheus (nested dicts are
+        # skipped by render_prometheus) and the SSE metrics deltas.
+        m = self._metrics
+        m.set_gauge("flight_eras", rec["era"])
+        m.set_gauge("flight_device_era_secs", rec["device_era_secs"])
+        m.set_gauge("flight_host_gap_secs", rec["host_gap_secs"])
 
     def _action_label(self, action: Any) -> str:
         """Memoized model.format_action — hot-loop action attribution must

@@ -1176,6 +1176,7 @@ class TpuBfsChecker(HostEngineBase):
             nonlocal head, count, take_cap, rec_bits, stop, params_dev
             with self._metrics.phase("readback"):
                 vals = np.asarray(params_dev)  # the ONE download per block
+            era_dt = 0.0
             if self._era_t0 is not None:
                 # The era's true wall time: dispatch through readback
                 # complete (dispatch alone returns immediately — JAX is
@@ -1302,6 +1303,20 @@ class TpuBfsChecker(HostEngineBase):
                 self._save_checkpoint(
                     table, queue, head, count, rec_bits, rec_fp1, rec_fp2
                 )
+
+            # Flight record after spill/checkpoint so this era's host work
+            # lands in its own host_gap (zero extra device reads: every
+            # field is from `vals` or host clocks).
+            self._flight_record(
+                device_era_secs=era_dt,
+                steps=int(vals[10]),
+                generated=int(vals[8]),
+                unique=self._unique,
+                frontier=count,
+                load_factor=round(self._unique / self._tcap, 4),
+                take_cap=take_cap,
+                spill_rows=spilled,
+            )
 
             if self._finish_matched(self._discovery_fps):
                 stop = True

@@ -730,7 +730,9 @@ class TpuSimulationChecker(HostEngineBase):
                 )
             with self._metrics.phase("readback"):
                 vals = np.asarray(params_dev)
-            self._metrics.add_phase("device_era", time.monotonic() - era_t0)
+            era_dt = time.monotonic() - era_t0
+            self._metrics.add_phase("device_era", era_dt)
+            self._metrics.observe("era_secs", era_dt)
             self._metrics.inc("eras")
             self._metrics.inc("steps", int(vals[P_STEPS]))
             gen_prev = gen_total
@@ -780,6 +782,13 @@ class TpuSimulationChecker(HostEngineBase):
                 frontier=self._B,
                 steps=int(vals[P_STEPS]),
                 generated=gen_total - gen_prev,
+            )
+            self._flight_record(
+                device_era_secs=era_dt,
+                steps=int(vals[P_STEPS]),
+                generated=gen_total - gen_prev,
+                unique=gen_total,
+                frontier=self._B,
             )
             if self._finish_matched(self._discovery_paths):
                 break

@@ -17,6 +17,11 @@ Reference parity: src/checker/explorer.rs. Routes:
     snapshot (obs/coverage.py): per-action fire counts, dead actions,
     depth histogram, per-property eval/hit counts — feeding the
     dashboard's action bar chart + depth histogram panel;
+  - ``GET /flight`` (alias ``/.flight``) — the run's flight recording
+    (obs/flight.py): the retained per-era records (device_era vs
+    host_gap wall split, frontier occupancy, load factor, spill/refill
+    volumes) plus the run-level summary — feeding the dashboard's
+    flight timeline panel;
   - ``GET /events`` — Server-Sent Events stream (text/event-stream):
     ``span`` events as the checker's spans complete (obs/spans.py) and
     periodic ``metrics`` events carrying the numeric telemetry deltas
@@ -267,14 +272,14 @@ def _metrics_view(checker: Checker) -> Dict:
 def _metrics_prometheus(checker: Checker) -> str:
     """GET /metrics?format=prometheus: the same snapshot in Prometheus
     text exposition format (obs/metrics.py:render_prometheus)."""
-    from ..obs.metrics import render_prometheus
+    from ..obs.metrics import SHARD_SERIES_LABELS, render_prometheus
 
     snap = dict(checker.telemetry())
     snap.setdefault("state_count", checker.state_count())
     snap.setdefault("unique_state_count", checker.unique_state_count())
     snap.setdefault("max_depth", checker.max_depth())
     snap.setdefault("done", checker.is_done())
-    return render_prometheus(snap)
+    return render_prometheus(snap, labels=SHARD_SERIES_LABELS)
 
 
 def _coverage_view(checker: Checker) -> Dict:
@@ -284,6 +289,19 @@ def _coverage_view(checker: Checker) -> Dict:
         "ts": time.time(),
         "done": checker.is_done(),
         "coverage": checker.coverage(),
+    }
+
+
+def _flight_view(checker: Checker) -> Dict:
+    """GET /flight: the run's flight recording (obs/flight.py) —
+    retained per-era records plus the run-level summary, timestamped
+    like /metrics so the dashboard can poll all three."""
+    summary = (checker.telemetry() or {}).get("flight") or {}
+    return {
+        "ts": time.time(),
+        "done": checker.is_done(),
+        "records": checker.flight(),
+        "summary": summary,
     }
 
 
@@ -472,6 +490,8 @@ class ExplorerServer:
                         self._send_json(_metrics_view(explorer.checker))
                 elif path in ("/coverage", "/.coverage"):
                     self._send_json(_coverage_view(explorer.checker))
+                elif path in ("/flight", "/.flight"):
+                    self._send_json(_flight_view(explorer.checker))
                 elif path in ("/events", "/.events"):
                     self._serve_sse(
                         explorer.spans,

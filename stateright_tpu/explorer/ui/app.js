@@ -259,6 +259,111 @@ async function pollCoverage() {
   setTimeout(pollCoverage, 2000);
 }
 
+// ---- flight timeline -------------------------------------------------------
+// Polls /flight every 2s: the per-era wall split (device_era stacked under
+// host_gap) as paired bars, and frontier occupancy (bars) with the table
+// load factor (line) on a second axis — the dispatch-gap story over eras.
+
+function renderFlightEras(records) {
+  const svg = $("flight-eras");
+  const w = svg.clientWidth || 480;
+  const h = svg.clientHeight || 48;
+  svg.innerHTML = "";
+  const maxWall = Math.max(...records.map((r) => r.wall_secs), 1e-9);
+  const bw = Math.max(1, (w - 2) / records.length - 1);
+  records.forEach((r, i) => {
+    const x = 1 + i * (bw + 1);
+    const devH = Math.max(1, (r.device_era_secs / maxWall) * (h - 2));
+    const gapH = (r.host_gap_secs / maxWall) * (h - 2);
+    const dev = document.createElementNS("http://www.w3.org/2000/svg", "rect");
+    dev.setAttribute("x", x.toFixed(1));
+    dev.setAttribute("y", (h - 1 - devH).toFixed(1));
+    dev.setAttribute("width", bw.toFixed(1));
+    dev.setAttribute("height", devH.toFixed(1));
+    dev.setAttribute("class", "flight-dev");
+    const tip = document.createElementNS("http://www.w3.org/2000/svg", "title");
+    tip.textContent =
+      `era ${r.era}: device ${(r.device_era_secs * 1000).toFixed(1)} ms, ` +
+      `gap ${(r.host_gap_secs * 1000).toFixed(1)} ms`;
+    dev.appendChild(tip);
+    svg.appendChild(dev);
+    if (gapH > 0.5) {
+      const gap = document.createElementNS("http://www.w3.org/2000/svg", "rect");
+      gap.setAttribute("x", x.toFixed(1));
+      gap.setAttribute("y", (h - 1 - devH - gapH).toFixed(1));
+      gap.setAttribute("width", bw.toFixed(1));
+      gap.setAttribute("height", gapH.toFixed(1));
+      gap.setAttribute("class", "flight-gap");
+      svg.appendChild(gap);
+    }
+  });
+}
+
+function renderFlightOccupancy(records) {
+  const svg = $("flight-occupancy");
+  const w = svg.clientWidth || 480;
+  const h = svg.clientHeight || 48;
+  svg.innerHTML = "";
+  const maxF = Math.max(...records.map((r) => r.frontier), 1);
+  const maxLf = Math.max(...records.map((r) => r.load_factor), 1e-9);
+  const bw = Math.max(1, (w - 2) / records.length - 1);
+  records.forEach((r, i) => {
+    const x = 1 + i * (bw + 1);
+    const bh = Math.max(1, (r.frontier / maxF) * (h - 2));
+    const bar = document.createElementNS("http://www.w3.org/2000/svg", "rect");
+    bar.setAttribute("x", x.toFixed(1));
+    bar.setAttribute("y", (h - 1 - bh).toFixed(1));
+    bar.setAttribute("width", bw.toFixed(1));
+    bar.setAttribute("height", bh.toFixed(1));
+    bar.setAttribute("class", "flight-frontier");
+    const tip = document.createElementNS("http://www.w3.org/2000/svg", "title");
+    tip.textContent =
+      `era ${r.era}: frontier ${r.frontier.toLocaleString()} rows, ` +
+      `load factor ${r.load_factor}`;
+    bar.appendChild(tip);
+    svg.appendChild(bar);
+  });
+  const pts = records.map((r, i) => [
+    1 + i * (bw + 1) + bw / 2,
+    h - 1 - (r.load_factor / maxLf) * (h - 2),
+  ]);
+  if (pts.length > 1) {
+    const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+    line.setAttribute(
+      "points",
+      pts.map((p) => p.map((v) => v.toFixed(1)).join(",")).join(" ")
+    );
+    line.setAttribute("class", "flight-lf-line");
+    svg.appendChild(line);
+  }
+}
+
+async function pollFlight() {
+  try {
+    const res = await fetch("/flight");
+    const body = await res.json();
+    const records = body.records || [];
+    if (records.length) {
+      $("flight-panel").hidden = false;
+      renderFlightEras(records);
+      renderFlightOccupancy(records);
+      const s = body.summary || {};
+      $("flight-era-readout").textContent =
+        `${s.eras || records.length} eras · device ` +
+        `${((s.device_secs || 0) * 1000).toFixed(0)} ms · host gap ` +
+        `${((s.host_gap_secs || 0) * 1000).toFixed(0)} ms ` +
+        `(${s.host_gap_pct != null ? s.host_gap_pct : 0}%)`;
+      const last = records[records.length - 1];
+      $("flight-occ-readout").textContent =
+        `latest: frontier ${last.frontier.toLocaleString()} rows · ` +
+        `load factor ${last.load_factor}`;
+    }
+  } catch (e) {
+    /* flight endpoint unavailable: leave the panel hidden */
+  }
+  setTimeout(pollFlight, 2000);
+}
+
 // ---- span waterfall (run ledger) -------------------------------------------
 // Span completions arrive live over GET /events (SSE, obs/spans.py). The
 // waterfall draws the most recent trace's spans as horizontal bars on a
@@ -466,5 +571,6 @@ window.addEventListener("hashchange", () => {
 pollStatus();
 pollMetrics();
 pollCoverage();
+pollFlight();
 startSpanStream();
 loadStates();

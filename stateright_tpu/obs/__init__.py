@@ -13,13 +13,25 @@ available. `render_prometheus` serializes any telemetry snapshot in the
 Prometheus text exposition format (the Explorer serves it at
 ``GET /metrics?format=prometheus``).
 
-See `obs/metrics.py` for the metric-name catalog, `obs/coverage.py` for
-coverage-count semantics, and `obs/trace.py` for the trace event schema.
+`obs/flight.py` adds the era-granularity flight recorder: per-era
+``device_era`` vs ``host_gap`` wall-time split plus frontier/table/spill
+counters, populated from the packed-params readback the device engines
+already do (`Checker.flight()`; `CheckerBuilder.flight()` configures it).
+
+See `stateright_tpu/obs/README.md` for the consolidated metric-name
+catalog, `obs/coverage.py` for coverage-count semantics, and
+`obs/trace.py` for the trace event schema.
 """
 
 from .coverage import DEPTH_CAP, Coverage
+from .flight import DEFAULT_FLIGHT_CAPACITY, FlightRecorder
 from .log import get_logger
-from .metrics import Histogram, MetricsRegistry, render_prometheus
+from .metrics import (
+    SHARD_SERIES_LABELS,
+    Histogram,
+    MetricsRegistry,
+    render_prometheus,
+)
 from .spans import SpanRecorder, attach_phase_spans, new_span_id, new_trace_id
 from .stageprof import STAGE_ORDER, stage_rows
 from .trace import (
@@ -31,11 +43,14 @@ from .trace import (
 )
 
 __all__ = [
+    "DEFAULT_FLIGHT_CAPACITY",
     "DEPTH_CAP",
     "ChromeTraceWriter",
     "Coverage",
+    "FlightRecorder",
     "Histogram",
     "MetricsRegistry",
+    "SHARD_SERIES_LABELS",
     "STAGE_ORDER",
     "SpanRecorder",
     "TraceWriter",

@@ -6,207 +6,13 @@ backs `Checker.telemetry()` for every engine, replacing the old
 loops must stay hot — and every method is thread-safe (host engines mutate
 from worker threads while `Checker.report()` polls from the caller's).
 
-Metric-name catalog
-===================
-
-Counters (`inc`) — monotonic totals:
-
-  =====================  =====================================================
-  name                   meaning
-  =====================  =====================================================
-  ``eras``               device dispatch+readback round-trips (device engines)
-  ``waves``              host frontier blocks processed (bfs/dfs/vbfs/on_demand)
-  ``rounds``             coordinator polling epochs (pbfs)
-  ``traces``             completed random walks (simulation engines)
-  ``steps``              device loop iterations actually executed
-  ``states_generated``   successor states generated (incl. duplicates)
-  ``spill_rows``         frontier rows spilled device -> host
-  ``refill_rows``        frontier rows refilled host -> device
-  ``table_growths``      visited-table doublings (grow + rehash)
-  ``expand_requests``    on-demand fingerprint expansions served
-  ``lint_<CODE>``        speclint diagnostics by stable code (e.g.
-                         ``lint_STR303``) when the run was linted — strict
-                         mode or an explicit `CheckerBuilder.lint()`
-                         (catalog: analysis/README.md)
-  ``conformance_events``  trace events consumed by `conformance.check_trace`
-  ``conformance_steps``   trace events explained as model transitions
-  ``conformance_stutters``  events the model prunes as no-ops (duplicate
-                         redeliveries, pure timer re-arms) — expected under
-                         fault injection, not divergences
-  ``conformance_faults``  injected-fault events recorded in the trace
-  ``conformance_divergences``  trace events the model could NOT explain
-                         (catalog: conformance/README.md)
-  ``serve_requests``     run-service submissions received (serve/service.py)
-  ``serve_rejected_lint``  submissions rejected by the speclint admission
-                         gate (422; STRxxx codes in the response body)
-  ``serve_rejected_quota``  submissions rejected by per-tenant quotas or
-                         rate limits (429)
-  ``serve_completed``    jobs finished with results available
-  ``serve_failed``       jobs that errored during execution
-  ``serve_cancelled``    jobs cancelled while queued
-  ``serve_exec_cache_hits``    executable-cache hits (a warm `CompiledCheck`
-                         served the run; engines/compiled.py)
-  ``serve_exec_cache_misses``  executable-cache misses (trace + lower paid)
-  ``serve_multiplexed_jobs``  jobs executed as lanes of a fused vmapped
-                         batch (engines/multiplex.py)
-  ``serve_batches``      multiplexed batch dispatches executed
-  ``serve_tenant_requests``  dict counter (`inc_labeled`): submissions per
-                         tenant id — rendered as a labeled
-                         ``{tenant="..."}`` series in the Prometheus
-                         exposition
-  ``checkpoint_saves``   crash-safe checkpoints written (tmp + fsync +
-                         generation rotation + rename; engines/common.py)
-  ``checkpoint_bytes``   total bytes of checkpoint payloads written
-  ``checkpoint_corrupt_rejected``  checkpoint generations rejected by the
-                         content digest (truncated/corrupt files)
-  ``checkpoint_fallbacks``  resumes that fell back to a previous rolling
-                         generation after the newest failed verification
-  ``degraded_regrow``    probe-budget exhaustions recovered by reloading
-                         the last checkpoint and doubling the table
-                         instead of aborting (graceful degradation)
-  ``journal_records`` / ``journal_bytes``  serve job-journal appends /
-                         bytes fsynced (serve/durability.py)
-  ``journal_compactions``  atomic journal rewrites to the folded state
-  ``journal_replayed_jobs``  jobs reconstructed from the journal at
-                         service restart
-  ``journal_recovered_queued``  replayed jobs re-enqueued (were queued)
-  ``journal_recovered_running``  replayed jobs re-enqueued as retries
-                         (were mid-flight when the service died)
-  ``journal_recovered_done``  replayed jobs whose persisted results were
-                         reloaded without re-running
-  ``retry_scheduled``    transient job failures scheduled for a backoff
-                         retry (invisible to the client)
-  ``retry_escalated_solo``  retries escalated from a multiplex lane to
-                         the solo engine (lane capacity failures)
-  ``retry_exhausted``    transient failures out of retry attempts
-                         (surfaced as failed)
-  ``serve_breaker_fastfail``  jobs fast-failed by an open per-signature
-                         circuit breaker
-  ``serve_worker_crashes``  dead worker threads detected and replaced by
-                         the guard
-  ``serve_admin_retries``  ``POST /jobs/{id}/retry`` re-enqueues
-  ``serve_results_persisted``  finished result payloads written to the
-                         on-disk result store
-  ``serve_results_gc``   persisted results expired past their TTL
-  =====================  =====================================================
-
-Gauges (`set_gauge`) — last-observed values:
-
-  =======================  ===================================================
-  name                     meaning
-  =======================  ===================================================
-  ``frontier_size``        pending rows/jobs after the last era/wave
-  ``max_depth``            deepest state visited so far
-  ``take_cap``             device engines' self-tuned pop width
-  ``load_factor``          visited-table occupancy / capacity
-  ``table_capacity``       visited-table capacity (per shard when sharded)
-  ``chunk``                device engines' data-parallel chunk width
-  ``walks`` / ``walk_cap`` simulation batch width / path-buffer depth
-  ``threads`` / ``workers``  host parallelism actually used
-  ``n_shards`` / ``quota``   mesh engine shard count / exchange quota
-  ``lint_errors`` / ``lint_warnings``  speclint finding counts by severity
-                           (linted runs only)
-  ``conformance_history_ops``  operations in the client history extracted
-                           from a checked trace (conformance/history.py)
-  ``coverage_actions_fired``  distinct actions observed firing so far
-                           (obs/coverage.py; the per-action breakdown is
-                           `Checker.coverage()`, not a metric)
-  ``coverage_dead_actions``  registered actions with a ZERO fire count —
-                           nonzero at run end means dead transitions or
-                           mis-modeled guards (speclint STR306 is the
-                           static twin)
-  ``small_workload_hint``  set (to the state count seen) when a device-engine
-                           run targets/explores fewer states than the
-                           host-vs-device crossover (~10k): the host engine
-                           would likely have been faster (one stderr line
-                           accompanies it)
-  ``stage_profile_iters``  per-stage loop repetitions used by the era stage
-                           profiler (`CheckerBuilder.stage_profile(iters=)`)
-  ``stage_us_per_step``    dict gauge: RAW isolated per-step cost of each era
-                           stage in microseconds, before proportional
-                           attribution (non-numeric; skipped by the
-                           Prometheus exposition)
-  ``stage_profile_model_pct``  how much of the measured era wall time the
-                           isolated-stage cost model accounts for (100 =
-                           stages explain the loop; low = fixed per-step
-                           overhead dominates; high = fusion beats the
-                           isolated kernels)
-  ``stage_profile_error``  repr of the exception if stage profiling failed
-                           (profiling is best-effort and never fails a run)
-  ``serve_queue_depth``    run-service jobs currently queued (serve/)
-  ``serve_active_jobs``    run-service jobs currently executing
-  ``interrupted``          set to 1 when a run stopped early for a graceful
-                           SIGTERM/SIGINT checkpoint flush
-                           (`request_checkpoint_stop`); the final
-                           checkpoint captures the stopping boundary
-  =======================  ===================================================
-
-Phase timers (`phase(name)` context manager / `add_phase`) — cumulative
-wall milliseconds per hot-path phase, surfaced as the nested ``phase_ms``
-dict in `snapshot()`:
-
-  =====================  =====================================================
-  phase                  measures
-  =====================  =====================================================
-  ``device_era``         one era: dispatch through params readback complete
-  ``readback``           device -> host stats/result downloads
-  ``upload``             host -> device parameter/frontier uploads
-  ``spill``              frontier spill downloads (device -> host)
-  ``refill``             frontier refill uploads (host -> device)
-  ``table_grow``         visited-table grow + rehash
-  ``checkpoint_save``    one crash-safe checkpoint write end-to-end
-                         (serialize + fsync + rotate + rename)
-  ``check_block``        one host BFS/DFS/on-demand block (pop..expand)
-  ``property_eval``      batched property evaluation (vbfs)
-  ``expand``             batched successor generation (vbfs)
-  ``hash``               batched fingerprinting (vbfs)
-  ``visited_insert``     visited-set probe + insert (vbfs native set)
-  ``walk``               one host simulation trace end-to-end
-  ``poll``               one pbfs coordinator polling epoch
-  ``stage_<name>``       the device engines' era wall time attributed to one
-                         pipeline stage (``stage_expand`` / ``stage_hash`` /
-                         ``stage_probe`` / ``stage_claim`` / ``stage_compact``
-                         / ``stage_ring``; plus ``stage_canon`` under
-                         symmetry, ``stage_exchange`` on the sharded mesh,
-                         and ``stage_cycle`` / ``stage_choose`` /
-                         ``stage_record`` on the simulation engine). Present
-                         only when the run used
-                         `CheckerBuilder.stage_profile()`; the stage shares
-                         sum to ``device_era`` by construction
-                         (obs/stageprof.py documents the attribution)
-  ``profiler_overhead``  wall time the stage profiler itself spent measuring
-                         (outside ``device_era``; the timed run is clean)
-  =====================  =====================================================
-
-Histograms (`observe`) — log-spaced latency distributions, surfaced as
-the nested ``histograms`` dict in `snapshot()` (per histogram: ``count``,
-``sum``, cumulative ``buckets`` as ``[le, count]`` pairs, and
-interpolated ``p50``/``p95``/``p99``), and rendered by
-`render_prometheus` as classic ``_bucket{le=...}`` / ``_sum`` /
-``_count`` families:
-
-  ==========================  ================================================
-  name                        observes (seconds)
-  ==========================  ================================================
-  ``submit_to_result_secs``   serve job latency, submission acknowledged to
-                              result recorded — retries, backoff waits, and
-                              queue time all included (serve/service.py);
-                              ``/stats``'s ``latency`` section reports its
-                              p50/p95/p99
-  ``queue_wait_secs``         serve job queue residency, enqueue to worker
-                              pickup (re-observed per requeue)
-  ``era_secs``                one device era dispatch→readback (device
-                              engines and multiplex lanes; the distribution
-                              twin of the cumulative ``device_era`` phase)
-  ==========================  ================================================
-
-Span phases — when a `SpanRecorder` (obs/spans.py) is attached, every
-phase timer above ALSO appears as a ``phase:<name>`` child span of the
-run/job span, so a Perfetto waterfall shows where a request's wall time
-went without new instrumentation in the hot loops.
-
-Engines only populate the rows that exist on their architecture; absent
-phases simply never appear in the snapshot.
+The full metric-name catalog — every counter, gauge, phase timer, and
+histogram with its meaning, plus the flight-recorder record schema —
+lives consolidated in ``stateright_tpu/obs/README.md`` (it used to be a
+docstring table here, with README.md and serve/README.md both pointing
+at it; one catalog now serves all three). Engines only populate the
+names that exist on their architecture; absent names simply never
+appear in the snapshot.
 """
 
 from __future__ import annotations
@@ -281,6 +87,31 @@ class Histogram:
             self._count += 1
             if value > self._max:
                 self._max = value
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram's observations into this one.
+
+        Both histograms must share bucket bounds — merging across
+        different bucketings would silently mis-bucket every count, so
+        a mismatch raises ``ValueError`` instead. The other histogram is
+        snapshotted under its own lock first, then applied under ours
+        (sequentially, never nested), so concurrent observers on either
+        side — or a self-merge — cannot deadlock."""
+        if other.bounds != self.bounds:
+            raise ValueError(
+                "cannot merge histograms with different bucket bounds: "
+                f"{len(self.bounds)} edges vs {len(other.bounds)}"
+            )
+        with other._lock:
+            counts = list(other._counts)
+            total, count, mx = other._sum, other._count, other._max
+        with self._lock:
+            for idx, n in enumerate(counts):
+                self._counts[idx] += n
+            self._sum += total
+            self._count += count
+            if mx > self._max:
+                self._max = mx
 
     @property
     def count(self) -> int:
@@ -450,6 +281,21 @@ _PROM_BAD = frozenset(" .-/:")
 def _prom_name(name: str, prefix: str) -> str:
     safe = "".join("_" if ch in _PROM_BAD else ch for ch in name)
     return prefix + safe
+
+
+#: Dict-valued metric name -> Prometheus label key for the sharded
+#: engine's per-shard series (`MetricsRegistry.inc_labeled` counters and
+#: dict gauges populated by parallel/mesh.py). Merge this into the
+#: ``labels=`` argument of `render_prometheus` so the per-shard series
+#: render as ``stateright_shard_steps{shard="3"} 1021`` instead of being
+#: skipped as non-numeric; the serve and Explorer endpoints do.
+SHARD_SERIES_LABELS = {
+    "shard_steps": "shard",
+    "shard_states_generated": "shard",
+    "shard_exchange_rows": "shard",
+    "shard_frontier_rows": "shard",
+    "shard_load_factor": "shard",
+}
 
 
 def render_prometheus(

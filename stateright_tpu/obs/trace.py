@@ -157,6 +157,20 @@ class ChromeTraceWriter:
                 )
             self._f.flush()
 
+    def write_counter_events(self, events) -> int:
+        """Merge pre-built counter records ("ph": "C" dicts on the same
+        epoch-microsecond clock as emit(); see FlightRecorder.
+        chrome_counter_events) into the open trace, so the flight
+        recorder's counter tracks render under the engine's phase lanes.
+        Returns the number of records written."""
+        with self._lock:
+            if self._f.closed:
+                return 0
+            for record in events:
+                self._write(record)
+            self._f.flush()
+        return len(events)
+
     def embed_spans(self, spans) -> int:
         """Merge completed span dicts (obs/spans.py SpanRecorder shape)
         into the open trace as B/E duration pairs. Spans use the same

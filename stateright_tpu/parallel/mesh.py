@@ -64,6 +64,12 @@ P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
 P_FIN_ALL_EN = 15
 P_LEN = 16
 
+#: Cross-shard frontier imbalance (max/mean occupancy) above which the
+#: engine logs a skew warning once per run. Hash-based ownership keeps
+#: real models near 1.0; several-fold skew means one device does most of
+#: the work while the rest idle in the lockstep collective.
+SHARD_IMBALANCE_WARN = 4.0
+
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
@@ -990,6 +996,18 @@ class ShardedBfsChecker(HostEngineBase):
         # table, so a handful of rounds covers any realistic exhaustion.
         regrow_budget = 8
 
+        # Per-shard exchange accounting: the per-era delta of each shard's
+        # P_UNIQUE row is the rows that shard accepted from the all_to_all
+        # exchange (plus its locally-kept share — ownership routing makes
+        # every insert an exchanged row). prev starts at ZERO, not the
+        # seeded values, so on a clean run the shard_exchange_rows series
+        # sums exactly to the final unique_state_count (seeding is era-0
+        # exchange volume by definition). A degraded_regrow reload resets
+        # prev to the checkpoint: replayed rows are physically re-exchanged
+        # and count again, so the identity is exact only for clean runs.
+        flight_prev_unique = np.zeros(N, dtype=np.int64)
+        imbalance_warned = False
+
         while counts.sum() > 0 or any(self._spill[s] for s in range(N)):
             # Refill spills per shard (one batched upload per shard).
             for s in range(N):
@@ -1055,6 +1073,7 @@ class ShardedBfsChecker(HostEngineBase):
                     0, 0, 0, 0, take_caps[s],
                     fin_any, fin_all, fin_all_en,
                 ]
+            _era_w0 = _time.monotonic()
             with self._metrics.phase("device_era"):
                 table, queue, rec_fp1, rec_fp2, params, disc_depth = (
                     self._block(
@@ -1063,6 +1082,8 @@ class ShardedBfsChecker(HostEngineBase):
                 )
                 with self._metrics.phase("readback"):
                     vals = np.asarray(params)  # the one download per block
+            era_wall = _time.monotonic() - _era_w0
+            self._metrics.observe("era_secs", era_wall)
 
             err = bool(vals[:, P_ERR].any())
             if not err and self._chaos_probe_error_era is not None and (
@@ -1092,6 +1113,9 @@ class ShardedBfsChecker(HostEngineBase):
                     table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
                     take_caps, disc_depth_best, per_shard_unique,
                 ) = self._load_checkpoint(self._ckpt_path, W)
+                flight_prev_unique = np.asarray(
+                    per_shard_unique, dtype=np.int64
+                )
                 with self._metrics.phase("table_grow"):
                     table = self._grow_tables(table)
                 self._metrics.inc("degraded_regrow")
@@ -1174,6 +1198,65 @@ class ShardedBfsChecker(HostEngineBase):
                         self._max_depth, int(big[:, S + 1].max())
                     )
 
+            # Per-shard telemetry off the same per-shard params rows (zero
+            # extra device reads): labeled counter series (Prometheus
+            # `{shard="k"}` via SHARD_SERIES_LABELS), per-shard gauges, and
+            # the cross-shard frontier imbalance gauge. The labeled sums
+            # equal the engine totals exactly — same vals columns.
+            shard_unique = np.asarray(per_shard_unique, dtype=np.int64)
+            exchange = np.maximum(0, shard_unique - flight_prev_unique)
+            flight_prev_unique = shard_unique
+            shards_rec = {}
+            for s in range(N):
+                key = str(s)
+                self._metrics.inc_labeled(
+                    "shard_steps", key, int(vals[s, P_STEPS])
+                )
+                self._metrics.inc_labeled(
+                    "shard_states_generated", key, int(vals[s, P_GEN])
+                )
+                self._metrics.inc_labeled(
+                    "shard_exchange_rows", key, int(exchange[s])
+                )
+                shards_rec[key] = {
+                    "frontier": int(counts[s]),
+                    "load_factor": round(
+                        int(shard_unique[s]) / max(1, self._tcap), 4
+                    ),
+                    "exchange_rows": int(exchange[s]),
+                }
+            self._metrics.set_gauge(
+                "shard_frontier_rows",
+                {k: v["frontier"] for k, v in shards_rec.items()},
+            )
+            self._metrics.set_gauge(
+                "shard_load_factor",
+                {k: v["load_factor"] for k, v in shards_rec.items()},
+            )
+            occ_mean = float(counts.mean())
+            imbalance = (
+                float(counts.max()) / occ_mean if occ_mean > 0 else 1.0
+            )
+            self._metrics.set_gauge("shard_imbalance", round(imbalance, 4))
+            # Skew on a near-empty frontier (the drain phase) is noise —
+            # only warn when the mean shard holds at least a full take.
+            if (
+                imbalance > SHARD_IMBALANCE_WARN
+                and occ_mean >= self._chunk
+                and not imbalance_warned
+            ):
+                imbalance_warned = True
+                from ..obs.log import get_logger
+
+                get_logger("parallel.mesh").warning(
+                    "cross-shard frontier imbalance: the busiest shard "
+                    "holds several times the mean occupancy (ownership "
+                    "hashing is skewed for this model)",
+                    imbalance=round(imbalance, 2),
+                    max_rows=int(counts.max()),
+                    mean_rows=round(occ_mean, 1),
+                )
+
             self._obs_event(
                 "era",
                 frontier=int(counts.sum()),
@@ -1194,6 +1277,24 @@ class ShardedBfsChecker(HostEngineBase):
                     table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
                     take_caps, disc_depth_best, per_shard_unique,
                 )
+
+            # Flight record after spill/checkpoint so this era's host work
+            # lands in its own host_gap. The mesh readback is nested inside
+            # the device_era phase, so era_wall (timed around the phase
+            # block above) is the device share directly.
+            self._flight_record(
+                device_era_secs=era_wall,
+                steps=int(vals[:, P_STEPS].sum()),
+                generated=int(vals[:, P_GEN].sum()),
+                unique=self._unique,
+                frontier=int(counts.sum()),
+                load_factor=round(
+                    max(per_shard_unique) / max(1, self._tcap), 4
+                ),
+                take_cap=int(min(take_caps)),
+                spill_rows=spilled,
+                shards=shards_rec,
+            )
 
             if self._finish_matched(self._discovery_fps):
                 break

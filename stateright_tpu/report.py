@@ -81,6 +81,12 @@ class WriteReporter(Reporter):
     # Moving-average window: at the default 1s sample delay this averages
     # over the last ~30s of progress.
     WINDOW = 30
+    # Instantaneous-rate damping: the trailing samples must span at least
+    # this many seconds. When eras shrink near the end of a run, polls can
+    # land a few milliseconds apart; a one-interval rate over such a
+    # sliver jitters wildly (and dragged the ETA with it), so `rate`
+    # reaches back over as many samples as needed to cover a real span.
+    MIN_RATE_SPAN = 0.25
 
     def __init__(self, writer: TextIO):
         self.writer = writer
@@ -91,17 +97,22 @@ class WriteReporter(Reporter):
         if len(self._samples) < 2:
             return ""
         (t0, s0) = self._samples[0]
-        (tp, sp) = self._samples[-2]
         (tn, sn) = self._samples[-1]
         # Sub-50ms windows (e.g. the first poll landing right after the
         # initial snapshot) extrapolate absurd rates; wait for real data.
         if tn - t0 < 0.05:
             return ""
+        # Walk back until the trailing span is long enough to damp jitter
+        # (stops at the window start for slow-polling callers).
+        k = len(self._samples) - 2
+        while k > 0 and tn - self._samples[k][0] < self.MIN_RATE_SPAN:
+            k -= 1
+        (tp, sp) = self._samples[k]
         avg = (sn - s0) / (tn - t0)
-        inst = (sn - sp) / (tn - tp) if tn > tp else avg
+        inst = max(0.0, (sn - sp) / (tn - tp)) if tn > tp else avg
         suffix = f", rate={_fmt_rate(inst)}, avg={_fmt_rate(avg)}"
         if data.target_states and avg > 0 and data.target_states > sn:
-            suffix += f", eta={int((data.target_states - sn) / avg)}s"
+            suffix += f", eta={max(0, int((data.target_states - sn) / avg))}s"
         return suffix
 
     def report_checking(self, data: ReportData) -> None:

@@ -48,7 +48,7 @@ from typing import Optional
 
 from ..explorer.server import JsonRequestHandler
 from ..obs.log import get_logger
-from ..obs.metrics import render_prometheus
+from ..obs.metrics import SHARD_SERIES_LABELS, render_prometheus
 from .service import RunService
 
 __all__ = ["ServeServer", "serve"]
@@ -81,7 +81,10 @@ class ServeServer:
                 ):
                     body = render_prometheus(
                         svc.telemetry(),
-                        labels={"serve_tenant_requests": "tenant"},
+                        labels={
+                            "serve_tenant_requests": "tenant",
+                            **SHARD_SERIES_LABELS,
+                        },
                     )
                     self._send(
                         200,

@@ -1,0 +1,227 @@
+"""The bench perf-regression gate (bench.py --history / --gate / --from):
+summary extraction, direction/threshold logic, rolling-baseline
+comparison, and the no-jax subprocess CLI path scripts/ci.sh relies on.
+"""
+
+import io
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO))
+
+from bench import (  # noqa: E402
+    GATE_BASELINE_WINDOW,
+    _gate_check,
+    _gate_direction,
+    append_history,
+    bench_summary,
+    gate_bench,
+    load_history,
+)
+
+
+def _record(rate=1000.0, p99=0.3, secs=3.0, overhead=0.5):
+    return {
+        "metric": "x",
+        "value": rate,
+        "unit": "states/sec",
+        "vs_baseline": 2.0,
+        "detail": {
+            "tpc7": {
+                "states_per_sec": rate,
+                "secs_median": secs,
+                "unique": 296_448,
+                "golden_match": True,
+                "telemetry": {"states_generated": 5, "eras": 3},
+                "flight": {"device_secs": 2.9, "host_gap_secs": 0.1},
+            },
+            "tpc7_span_cost": {"overhead_pct": overhead},
+            "service": {
+                "latency": {
+                    "submit_to_result": {
+                        "p50": 0.1,
+                        "p95": 0.2,
+                        "p99": p99,
+                        "count": 8,
+                    }
+                }
+            },
+        },
+    }
+
+
+# -- summary extraction -------------------------------------------------------
+
+
+def test_summary_selects_gate_relevant_metrics_only():
+    s = bench_summary(_record())
+    assert s["value"] == 1000.0
+    assert s["detail.tpc7.states_per_sec"] == 1000.0
+    assert s["detail.tpc7.secs_median"] == 3.0
+    assert s["detail.tpc7_span_cost.overhead_pct"] == 0.5
+    assert s["detail.service.latency.submit_to_result.p99"] == 0.3
+    # Diagnostic/environment sections stay out of the gate: telemetry
+    # counters, flight wall totals, golden booleans, raw counts.
+    for key in s:
+        assert ".telemetry." not in key and ".flight." not in key, key
+    assert "detail.tpc7.unique" not in s
+    assert "detail.service.latency.submit_to_result.count" not in s
+    assert "detail.tpc7.golden_match" not in s
+
+
+def test_direction_inference():
+    assert _gate_direction("value") == "higher"
+    assert _gate_direction("detail.tpc7.states_per_sec") == "higher"
+    assert _gate_direction("detail.pbfs.speedup") == "higher"
+    assert _gate_direction("a.p99") == "lower"
+    assert _gate_direction("a.secs_median") == "lower"
+    assert _gate_direction("a.overhead_pct") == "lower"
+    assert _gate_direction("detail.tpc7.unique") is None
+
+
+# -- per-metric check ---------------------------------------------------------
+
+
+def test_gate_check_rate_budget():
+    assert _gate_check("value", 1000.0, 900.0) is None  # -10%: within
+    assert _gate_check("value", 1000.0, 840.0) is not None  # -16%: trips
+    assert _gate_check("value", 1000.0, 1500.0) is None  # faster is fine
+
+
+def test_gate_check_latency_budget_with_noise_floor():
+    key = "detail.service.latency.submit_to_result.p99"
+    assert _gate_check(key, 1.0, 1.2) is None  # +20%: within
+    assert _gate_check(key, 1.0, 1.3) is not None  # +30%: trips
+    # Sub-floor absolute moves never trip, however large relatively.
+    assert _gate_check(key, 0.01, 0.03) is None
+    key = "detail.tpc7_span_cost.overhead_pct"
+    assert _gate_check(key, 0.2, 0.9) is None  # < 1.0pp absolute floor
+    assert _gate_check(key, 1.0, 2.5) is not None
+
+
+# -- rolling baseline ---------------------------------------------------------
+
+
+def test_gate_empty_history_passes(tmp_path):
+    out = io.StringIO()
+    assert gate_bench(str(tmp_path / "none.jsonl"), _record(), out) == 0
+    assert "seed run" in out.getvalue()
+
+
+def test_gate_parity_passes_and_regression_fails(tmp_path):
+    hist = str(tmp_path / "h.jsonl")
+    append_history(hist, _record())
+    append_history(hist, _record(rate=1020.0))
+    out = io.StringIO()
+    assert gate_bench(hist, _record(rate=990.0), out) == 0
+    out = io.StringIO()
+    assert gate_bench(hist, _record(rate=700.0), out) == 1
+    assert "REGRESSION value" in out.getvalue()
+
+
+def test_gate_baseline_is_median_of_last_window(tmp_path):
+    hist = str(tmp_path / "h.jsonl")
+    # One ancient slow run, then GATE_BASELINE_WINDOW fast ones: the
+    # rolling window must forget the slow outlier entirely.
+    append_history(hist, _record(rate=10.0))
+    for _ in range(GATE_BASELINE_WINDOW):
+        append_history(hist, _record(rate=1000.0))
+    assert gate_bench(hist, _record(rate=700.0), io.StringIO()) == 1
+    # And a single fast outlier inside the window cannot poison the
+    # median baseline.
+    hist2 = str(tmp_path / "h2.jsonl")
+    for rate in (1000.0, 1000.0, 5000.0, 1000.0, 1000.0):
+        append_history(hist2, _record(rate=rate))
+    assert gate_bench(hist2, _record(rate=950.0), io.StringIO()) == 0
+
+
+def test_history_rows_are_flat_jsonl(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    summary = append_history(str(hist), _record())
+    rows = load_history(str(hist))
+    assert rows == [summary]
+    # Every row is a flat {dotted-key: number} dict — greppable and
+    # mergeable across bench versions.
+    assert all(
+        isinstance(v, (int, float)) for v in rows[0].values()
+    )
+    # Corrupt/blank lines are skipped, not fatal.
+    with open(hist, "a") as f:
+        f.write("not json\n\n")
+    append_history(str(hist), _record())
+    assert len(load_history(str(hist))) == 2
+
+
+# -- CLI: the no-jax --from path ----------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), *args],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=str(REPO),
+    )
+
+
+@pytest.fixture()
+def bench_json(tmp_path):
+    path = tmp_path / "BENCH.json"
+    path.write_text(json.dumps(_record()) + "\n")
+    return str(path)
+
+
+def test_cli_from_gate_and_history_roundtrip(tmp_path, bench_json):
+    hist = str(tmp_path / "hist.jsonl")
+    # Seed: empty history passes and appends the baseline row.
+    r = _run_cli("--from", bench_json, "--gate", hist, "--history", hist)
+    assert r.returncode == 0, r.stderr
+    assert "seed run" in r.stdout
+    # Parity passes.
+    r = _run_cli("--from", bench_json, "--gate", hist)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # A regressed record trips the gate with a nonzero exit.
+    slow = tmp_path / "SLOW.json"
+    slow.write_text(json.dumps(_record(rate=700.0, p99=0.9)) + "\n")
+    r = _run_cli("--from", str(slow), "--gate", hist)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+    # The gate ran BEFORE any append: the history still has one row.
+    assert len(load_history(hist)) == 1
+
+
+def test_cli_from_does_not_import_jax(bench_json, tmp_path):
+    # ci.sh may gate records on boxes without an accelerator stack; the
+    # --from path must never import jax. A poisoned jax on sys.path
+    # proves it by construction.
+    trap = tmp_path / "jax"
+    trap.mkdir()
+    (trap / "__init__.py").write_text("raise ImportError('jax imported')\n")
+    r = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "bench.py"),
+            "--from",
+            bench_json,
+            "--gate",
+            str(tmp_path / "h.jsonl"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=str(tmp_path),
+        env={"PYTHONPATH": f"{tmp_path}", "PATH": "/usr/bin:/bin"},
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_from_requires_an_action(bench_json):
+    r = _run_cli("--from", bench_json)
+    assert r.returncode != 0
+    assert "usage" in (r.stdout + r.stderr).lower()

@@ -184,6 +184,143 @@ def test_reporter_rate_units():
     assert _fmt_rate(2_500_000.0) == "2.50M/s"
 
 
+def test_reporter_eta_damps_shrinking_era_jitter():
+    """Regression: near the end of a run eras shrink and polls can land
+    milliseconds apart; the one-interval instantaneous rate over such a
+    sliver whipsawed the rate and ETA. The trailing span now reaches
+    back until it covers MIN_RATE_SPAN."""
+    out = io.StringIO()
+    r = WriteReporter(out)
+    mk = lambda states, secs: ReportData(
+        total_states=states,
+        unique_states=states,
+        max_depth=1,
+        duration_secs=secs,
+        done=False,
+        target_states=10_000,
+    )
+    r.report_checking(mk(0, 0.0))
+    r.report_checking(mk(1000, 1.0))
+    r.report_checking(mk(1500, 1.02))  # 20ms after the previous poll
+    lines = out.getvalue().splitlines()
+    # Undamped this would read (1500-1000)/0.02 = "25.0k/s"; reaching
+    # back to a >= 0.25s span reads (1500-0)/1.02 ≈ 1.5k/s instead.
+    assert "rate=1.5k/s" in lines[2], lines[2]
+    assert "25.0k/s" not in lines[2]
+    assert "eta=5s" in lines[2], lines[2]
+
+
+def test_reporter_eta_never_negative():
+    out = io.StringIO()
+    r = WriteReporter(out)
+    mk = lambda states, secs: ReportData(
+        total_states=states,
+        unique_states=states,
+        max_depth=1,
+        duration_secs=secs,
+        done=False,
+        target_states=1_000,
+    )
+    r.report_checking(mk(0, 0.0))
+    r.report_checking(mk(1500, 1.0))  # overshot the target
+    r.report_checking(mk(1400, 2.0))  # synthetic counter retreat
+    lines = out.getvalue().splitlines()
+    # Past the target: the ETA is omitted rather than negative.
+    assert "rate=" in lines[1] and "eta=" not in lines[1], lines[1]
+    # A retreating count floors the instantaneous rate at zero.
+    assert "rate=0/s" in lines[2] and "eta=" not in lines[2], lines[2]
+
+
+# -- Histogram.merge edge cases -----------------------------------------------
+
+
+def test_histogram_merge_empty_and_populated():
+    from stateright_tpu.obs.metrics import Histogram
+
+    a = Histogram()
+    for v in (0.001, 0.01, 0.5):
+        a.observe(v)
+    before = a.snapshot()
+    a.merge(Histogram())  # merging an empty histogram is a no-op
+    assert a.snapshot() == before
+    b = Histogram()
+    b.merge(a)  # populated into empty: exact copy
+    assert b.snapshot() == before
+
+
+def test_histogram_merge_mismatched_bounds_raises():
+    from stateright_tpu.obs.metrics import Histogram
+
+    a = Histogram(bounds=[0.1, 1.0, 10.0])
+    b = Histogram(bounds=[0.2, 2.0])
+    b.observe(0.15)
+    with pytest.raises(ValueError, match="bucket bounds"):
+        a.merge(b)
+    assert a.count == 0  # the refused merge left no partial counts
+
+
+def test_histogram_self_merge_doubles_counts():
+    from stateright_tpu.obs.metrics import Histogram
+
+    h = Histogram(bounds=[1.0, 2.0, 4.0])
+    h.observe(0.5)
+    h.observe(3.0)
+    h.merge(h)  # sequential locking: self-merge must not deadlock
+    assert h.count == 4
+    assert h.sum == pytest.approx(7.0)
+    assert h.buckets()[-1][1] == 4
+
+
+def test_histogram_single_observation_quantiles():
+    from stateright_tpu.obs.metrics import Histogram
+
+    h = Histogram(bounds=[1.0, 2.0, 4.0])
+    h.observe(1.5)
+    # With one observation every quantile IS that observation: the
+    # in-bucket interpolation clamps to the observed max instead of
+    # reporting a fictitious bucket-edge latency.
+    for q in (0.5, 0.95, 0.99, 1.0):
+        assert h.quantile(q) == 1.5
+    snap = h.snapshot()
+    assert snap["p50"] == snap["p99"] == 1.5
+
+
+# -- Prometheus labeled series ------------------------------------------------
+
+
+def test_render_prometheus_labeled_dict_counters():
+    from stateright_tpu.obs.metrics import render_prometheus
+
+    snap = {
+        "engine": "TestEngine",
+        "shard_exchange_rows": {"1": 7, "0": 5, "10": 2},
+        "serve_tenant_requests": {'we"ird\\ten': 3},
+        "plain": 4,
+        "unlabeled": {"x": 1},
+    }
+    text = render_prometheus(
+        snap,
+        labels={
+            "shard_exchange_rows": "shard",
+            "serve_tenant_requests": "tenant",
+        },
+    )
+    # One series per label value, lexicographically ordered.
+    i0 = text.index('stateright_shard_exchange_rows{shard="0"} 5')
+    i1 = text.index('stateright_shard_exchange_rows{shard="1"} 7')
+    i10 = text.index('stateright_shard_exchange_rows{shard="10"} 2')
+    assert i0 < i1 < i10
+    # Backslashes and quotes in label values are escaped.
+    assert (
+        'stateright_serve_tenant_requests{tenant="we\\"ird\\\\ten"} 3'
+        in text
+    )
+    # Plain numerics render flat; dict metrics WITHOUT a label mapping
+    # are skipped entirely (JSON-only gauges).
+    assert "stateright_plain 4" in text
+    assert "unlabeled" not in text
+
+
 # -- Checker.telemetry() non-empty for EVERY engine ---------------------------
 
 
