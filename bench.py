@@ -401,10 +401,16 @@ def compare_bench(path_a, path_b, out=None):
 GATE_BASELINE_WINDOW = 5
 
 # Direction inference by metric-name fragment. Higher-better: throughput
-# rates and speedups. Lower-better: wall times, latency quantiles, and
-# instrumentation overheads. Keys matching neither stay out of the gate.
+# rates and speedups. Lower-better: wall times, latency quantiles,
+# instrumentation overheads, the flight recorder's host-gap share
+# (dispatch-bound idle time the pipelining work exists to remove), and
+# era counts (fewer eras = bigger mega-eras = fewer host round-trips).
+# Keys matching neither stay out of the gate.
 _GATE_HIGHER = ("states_per_sec", "checks_per_sec", "per_sec", "speedup")
-_GATE_LOWER = ("p50", "p95", "p99", "secs", "ms", "overhead_pct")
+_GATE_LOWER = (
+    "p50", "p95", "p99", "secs", "ms", "overhead_pct",
+    "host_gap_pct", "eras",
+)
 
 # Sections whose numeric leaves are environment/diagnostic detail, not
 # performance contracts — excluded from the gated summary.
@@ -477,7 +483,8 @@ def _gate_check(key, base, cur):
 
     Rates get a 15% budget; latency/overhead metrics get 25% plus an
     absolute floor (0.05s-equivalent; 1.0 percentage point for
-    `overhead_pct`) so near-zero baselines don't trip on noise.
+    `overhead_pct` / `host_gap_pct`) so near-zero baselines don't trip
+    on noise.
     """
     if base <= 0:
         return None
@@ -485,7 +492,7 @@ def _gate_check(key, base, cur):
         if cur < base * (1.0 - 0.15):
             return f"{(cur / base - 1.0) * 100.0:+.1f}% (budget -15%)"
         return None
-    floor = 1.0 if key.endswith("overhead_pct") else 0.05
+    floor = 1.0 if key.endswith(("overhead_pct", "host_gap_pct")) else 0.05
     if cur > base * (1.0 + 0.25) and cur - base > floor:
         return f"{(cur / base - 1.0) * 100.0:+.1f}% (budget +25%)"
     return None
@@ -855,8 +862,17 @@ def main() -> int:
     recon7 = TensorModelAdapter(tm7).checker().spawn_tpu_bfs(**opts).join()
     recon_wall = time.perf_counter() - t0
     fsum = recon7.telemetry()["flight"]
+    # Overlap-aware identity: under speculative pipelining the engine's
+    # per-era device spans can exceed the wall deltas between readbacks;
+    # the recorder books the excess as overlap_secs, and the run-level
+    # reconciliation is device - overlap + gap == wall.
     recon_err_pct = (
-        abs(fsum["device_secs"] + fsum["host_gap_secs"] - recon_wall)
+        abs(
+            fsum["device_secs"]
+            - fsum.get("overlap_secs", 0.0)
+            + fsum["host_gap_secs"]
+            - recon_wall
+        )
         / recon_wall
         * 100.0
     )

@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Nine stages, fail-fast:
+# Ten stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -30,7 +30,10 @@
 #      seeds a throwaway history, a parity rerun must pass the gate,
 #      and a BENCH_PERTURB_SLEEP-degraded rerun must trip it — proving
 #      `bench.py --gate` actually fails CI on a real regression,
-#   9. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#   9. a pipelining smoke: a tiny run with speculative era dispatch
+#      forced ON (many short eras) must golden-match the serial driver
+#      bit-for-bit and report a flight summary with `host_gap_pct`,
+#  10. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -301,6 +304,50 @@ if JAX_PLATFORMS=cpu BENCH_PERTURB_SLEEP=2.5 \
 fi
 rm -rf "$gate_tmp"
 echo "perf-gate smoke OK: parity passed, degraded run tripped the gate"
+
+echo "== pipelining smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+# Many short eras (sync_steps=4) so speculative chains actually engage.
+opts = dict(
+    chunk_size=64, queue_capacity=1 << 12, table_capacity=1 << 11,
+    sync_steps=4,
+)
+
+
+def run(pipelined):
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .coverage()
+        .pipeline(pipelined)
+        .spawn_tpu_bfs(**opts)
+        .join()
+    )
+    cov = c.coverage()
+    return c, (
+        c.unique_state_count(), c.state_count(), c.max_depth(),
+        dict(c._discovery_fps), cov["actions"], cov["depths"],
+    )
+
+
+piped, fp_on = run(True)
+_serial, fp_off = run(False)
+assert fp_on[0] == 8832, fp_on[0]
+assert fp_on == fp_off, "pipelined run diverged from the serial driver"
+tel = piped.telemetry()
+assert tel.get("spec_dispatch", 0) >= 1, "pipelining never speculated"
+fsum = tel["flight"]
+assert "host_gap_pct" in fsum, fsum
+print(
+    f"pipelining smoke OK: 8832 uniques golden-match serial, "
+    f"{tel['spec_dispatch']} speculative dispatches "
+    f"({tel.get('spec_wasted', 0)} wasted), "
+    f"host_gap_pct={fsum['host_gap_pct']}"
+)
+PY
 
 echo "== tier-1 tests =="
 set -o pipefail

@@ -64,6 +64,7 @@ class CheckerBuilder:
         self.flight_capacity_: int = 4096
         self.flight_path_: Optional[str] = None
         self.flight_format_: str = "jsonl"
+        self.pipeline_: bool = True
 
     # -- options ------------------------------------------------------------
 
@@ -220,6 +221,21 @@ class CheckerBuilder:
         engines (their phases are timed directly)."""
         self.stage_profile_ = enable
         self.stage_profile_iters_ = max(1, int(iters))
+        return self
+
+    def pipeline(self, enable: bool = True) -> "CheckerBuilder":
+        """Speculative era pipelining on the device engines (default ON).
+
+        While era N's packed-params readback is still in flight, the
+        driver chains era N+1 directly off the still-on-device
+        table/queue/params — the device loop's entry gate makes the
+        chained dispatch an exact no-op whenever era N actually needed
+        host intervention (spill, grow, discovery finish, probe error),
+        so results are bit-identical to the serial driver; only the
+        dispatch gap between eras disappears. Disable to force the
+        serial dispatch -> readback -> dispatch driver (useful when
+        bisecting timing-sensitive telemetry)."""
+        self.pipeline_ = bool(enable)
         return self
 
     # -- static analysis (speclint; stateright_tpu.analysis) -----------------

@@ -144,7 +144,10 @@ def _build_lane_program(tm: TensorModel, props, lanes: int, chunk: int,
         table, queue, rec_fp1, rec_fp2, params_out = loop_fn(
             table, queue, rec_fp1, rec_fp2, params
         )
-        return jnp.stack(table), params_out
+        # Split the packed key buffer back into the four flat lanes the
+        # bundle/snapshot format stacks (see visited_set.empty_table).
+        keys, pv1, pv2 = table
+        return jnp.stack([keys[:tcap], keys[tcap:], pv1, pv2]), params_out
 
     program = jax.jit(jax.vmap(one_lane))
     _MUX_CACHE[key] = (tm, program)

@@ -93,7 +93,8 @@ P_REC = 3  # recorded-discovery bitmask (bit i = property i)
 P_DEPTH_LIMIT = 4
 P_GROW_LIMIT = 5  # era exits when unique exceeds this (host grows table)
 P_HIGH_WATER = 6  # era exits when count exceeds this (host spills)
-P_MAX_STEPS = 7  # step budget per era (host polls timeout/targets/ckpt)
+P_MAX_STEPS = 7  # IN: step budget per era (host polls timeout/targets/ckpt);
+# OUT: the NEXT era's adaptive budget (device-emitted, see P_BUDGET_CAP)
 P_GEN = 8  # OUT: generated states this era
 P_MAXD = 9  # OUT: max depth seen this era
 P_STEPS = 10  # OUT: steps actually executed this era
@@ -102,7 +103,10 @@ P_TAKE_CAP = 12  # persisted across eras (self-tuned on vcap overflow)
 P_FIN_ANY = 13  # era exits when (rec & fin_any) != 0
 P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
 P_FIN_ALL_EN = 15
-P_LEN = 16
+P_BUDGET_CAP = 16  # upper clamp for the device-adaptive step budget;
+# 0 = adaptivity OFF (P_MAX_STEPS passes through unchanged — free-running
+# and target-bounded runs keep the legacy fixed-budget behavior)
+P_LEN = 17
 # The packed vector is P_LEN + 2*P (+ coverage tail) words long: the tail
 # carries the recorded discovery fingerprint halves (rec_fp1 | rec_fp2),
 # so the era result download returns counters AND discovery fingerprints
@@ -117,6 +121,11 @@ P_LEN = 16
 
 
 _COV_W = 16  # relative depth-offset window of the era loop's histogram
+
+# Adaptive era budget floor: the smallest per-era step budget the device
+# emission may shrink to under spill/grow pressure, and the slow-start
+# seed the host begins wall-clock-polled runs at.
+BUDGET_MIN = 64
 
 
 def _cov_len(A: int, P: int) -> int:
@@ -210,6 +219,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         fin_any = params[P_FIN_ANY]
         fin_all = params[P_FIN_ALL]
         fin_all_en = params[P_FIN_ALL_EN]
+        budget_cap = params[P_BUDGET_CAP]
         # The era is a data-dependent `lax.while_loop` whose predicate runs
         # ON DEVICE (measured round 4: a jitted while predicate costs
         # nothing extra — the old belief that it forced a host round-trip
@@ -347,7 +357,12 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             # cost for a fraction of the throughput (stage-profiled: the
             # per-step cost is width-insensitive below chunk). /16 restores
             # full width within ~16 clean steps while still backing off
-            # geometrically under repeated overflow.
+            # geometrically under repeated overflow. Recovery is counted
+            # in STEPS, not eras, and the cap round-trips through
+            # P_TAKE_CAP — so adaptive era budgets (which make early eras
+            # as short as BUDGET_MIN steps) and chained speculative
+            # dispatches never reset or stall the climb; a halved cap
+            # keeps recovering seamlessly across era boundaries.
             take_cap = jnp.where(
                 ovf,
                 jnp.maximum(take >> u(1), u(1)),
@@ -519,6 +534,40 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         maxd = jnp.where(
             steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
+        # Adaptive era budget (device-side emission): the NEXT era's step
+        # budget rides the P_MAX_STEPS output slot, so a chained
+        # (speculative) dispatch follows the exact deterministic schedule
+        # the serial driver would. TCP-slow-start shape: double after an
+        # era that exhausted its budget with no other exit reason pending,
+        # halve under spill/grow pressure, floor at BUDGET_MIN, clamp at
+        # budget_cap. budget_cap == 0 turns the emission off (pure
+        # pass-through — free-running and target-bounded runs keep their
+        # fixed budgets). The host's wall-clock cap keeps checkpoint
+        # cadence and reporter updates honest (see the engine driver).
+        fin_hit_final = ((rec_bits_out & fin_any) != u(0)) | (
+            (fin_all_en != u(0)) & ((rec_bits_out & fin_all) == fin_all)
+        )
+        pressure = (count > high_water) | (unique > grow_limit)
+        budget_only = (
+            (steps >= max_steps)
+            & (count > u(0))
+            & ~pressure
+            & (err_cnt == u(0))
+            & ~fin_hit_final
+        )
+        # In adaptive mode max_steps <= budget_cap <= 2^30 always (host
+        # clamp), so the doubling cannot overflow uint32.
+        grown = jnp.minimum(jnp.maximum(max_steps, u(1)) * u(2), budget_cap)
+        shrunk = jnp.maximum(
+            jnp.minimum(max_steps, budget_cap) >> u(1), u(BUDGET_MIN)
+        )
+        next_budget = jnp.where(
+            budget_cap == u(0),
+            max_steps,
+            jnp.where(
+                pressure, shrunk, jnp.where(budget_only, grown, max_steps)
+            ),
+        )
         parts = [
             jnp.stack(
                 [
@@ -529,7 +578,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                     depth_limit,
                     grow_limit,
                     high_water,
-                    max_steps,
+                    next_budget,
                     gen,
                     maxd,
                     steps,
@@ -538,6 +587,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                     fin_any,
                     fin_all,
                     fin_all_en,
+                    budget_cap,
                 ]
             ),
             rec_fp1,
@@ -1008,6 +1058,12 @@ class TpuBfsChecker(HostEngineBase):
         # apply — firing it there would steer users away from the right
         # engine.
         self._mux_lane = bool(getattr(builder, "multiplex_lane_", False))
+        # Speculative era pipelining (CheckerBuilder.pipeline(), default
+        # on): chain era N+1 off the still-on-device state while era N's
+        # readback is in flight. See the _run driver for the soundness
+        # argument (chained dispatch is an identity no-op on every
+        # device-visible host-intervention exit).
+        self._pipeline = bool(getattr(builder, "pipeline_", True))
         # Small-workload guard: with a state-count target under the
         # crossover, the host engine will beat this one — say so up front
         # (the run-end check below catches untargeted small runs).
@@ -1056,14 +1112,35 @@ class TpuBfsChecker(HostEngineBase):
         max_sync = (
             self._max_sync_steps
             if self._timeout is None and self._ckpt_every is None
-            else min(64, self._max_sync_steps)
+            else min(BUDGET_MIN, self._max_sync_steps)
         )
+        # Adaptive era budget (TCP-slow-start): engaged only when a wall-
+        # clock concern forces polling. The DEVICE emits the next era's
+        # budget through the P_MAX_STEPS output slot (doubling after
+        # budget-only exits, halving under spill/grow pressure — see
+        # _build_loop's epilogue), which keeps the schedule deterministic
+        # and identical whether eras are dispatched serially or
+        # speculatively. The host only moves the CAP, by wall-clock
+        # feedback, so growing eras can never starve checkpoint cadence or
+        # reporter updates. budget_cap == 0 disables the emission entirely
+        # (free-running runs keep the full fixed allowance).
+        adaptive = self._timeout is not None or self._ckpt_every is not None
+        budget = max_sync
+        budget_cap = min(BUDGET_MIN, max_sync) if adaptive else 0
+        cap_limit = min(self._max_sync_steps, 1 << 30)  # uint32-safe doubling
+        poll_target = None
+        if self._ckpt_every is not None:
+            poll_target = self._ckpt_every / 4.0
+        if self._timeout is not None:
+            t = self._timeout / 4.0
+            poll_target = t if poll_target is None else min(poll_target, t)
         # Finish-policy discovery masks for the device-side early exit.
         fin_any, fin_all, fin_all_en = self._finish_when.device_masks(
             self._tprops
         )
         params_dev = None
         last_max_steps = None
+        last_budget_cap = budget_cap
         take_cap = self._chunk
 
         _dbg("run: encoding inits")
@@ -1137,6 +1214,7 @@ class TpuBfsChecker(HostEngineBase):
             template[P_FIN_ANY] = fin_any
             template[P_FIN_ALL] = fin_all
             template[P_FIN_ALL_EN] = fin_all_en
+            template[P_BUDGET_CAP] = budget_cap
             template[P_GROW_LIMIT] = max(
                 0, int(vs.MAX_LOAD * self._tcap) - vcap
             )
@@ -1169,11 +1247,18 @@ class TpuBfsChecker(HostEngineBase):
         spill_target = max(high_water // 2, high_water - 64 * C * A)
         stop = False
 
-        def process_result():
+        def process_result(spec_in_flight=False):
             """Consume one era result (the fused seed+first-era dispatch or
             a loop dispatch): counters, discoveries, spill, checkpoints,
-            and stop conditions."""
+            and stop conditions. With ``spec_in_flight`` a chained
+            speculative era is still executing on device: the checkpoint
+            save is deferred to the next serial boundary (the table/queue
+            bindings here are the NEXT era's output buffers, so a save now
+            could pair this era's head/count with a ring the next era has
+            already advanced — unless that era is a no-op, which the
+            caller cannot know yet)."""
             nonlocal head, count, take_cap, rec_bits, stop, params_dev
+            nonlocal budget, budget_cap
             with self._metrics.phase("readback"):
                 vals = np.asarray(params_dev)  # the ONE download per block
             era_dt = 0.0
@@ -1218,6 +1303,22 @@ class TpuBfsChecker(HostEngineBase):
             head = int(vals[0])
             count = int(vals[1])
             take_cap = int(vals[P_TAKE_CAP])
+            # Device-emitted next-era budget (pass-through when adaptivity
+            # is off); the budget USED by the era just consumed is gauged
+            # for the obs catalog.
+            budget = int(vals[P_MAX_STEPS])
+            if last_max_steps is not None:
+                self._metrics.set_gauge(
+                    "era_step_budget", int(last_max_steps)
+                )
+            if poll_target is not None and era_dt > 0.0:
+                # Wall-clock cap feedback: let the device's slow-start
+                # climb only while eras stay well inside the polling
+                # cadence; back the cap off when an era overshoots it.
+                if era_dt < poll_target / 2 and budget_cap < cap_limit:
+                    budget_cap = min(budget_cap * 2, cap_limit)
+                elif era_dt > poll_target and budget_cap > BUDGET_MIN:
+                    budget_cap = max(budget_cap // 2, BUDGET_MIN)
             self._metrics.inc("eras")
             self._metrics.inc("steps", int(vals[10]))
             self._metrics.inc("states_generated", int(vals[8]))
@@ -1296,7 +1397,7 @@ class TpuBfsChecker(HostEngineBase):
                 spill_rows=spilled,
             )
 
-            if self._ckpt_path is not None and (
+            if not spec_in_flight and self._ckpt_path is not None and (
                 self._ckpt_every is not None
                 and time.monotonic() - self._last_ckpt >= self._ckpt_every
             ):
@@ -1340,6 +1441,22 @@ class TpuBfsChecker(HostEngineBase):
         # a handful of rounds covers any realistic exhaustion; an unbounded
         # loop would mask a genuinely pathological model.
         regrow_budget = 8
+
+        # Speculative era pipelining (tentpole; CheckerBuilder.pipeline()):
+        # the device loop re-derives EVERY exit condition from the chained
+        # params vector — count/high_water/grow_limit/fin bits/err_cnt all
+        # gate the while predicate, and err_cnt seeds from P_ERR — so an
+        # era dispatched off a host-intervention boundary is an exact
+        # identity no-op (outputs value-identical to inputs). That makes
+        # chaining era N+1 before era N's readback unconditionally SOUND
+        # for device-visible exits; the chain is simply not entered while
+        # any host-ONLY concern (spill-backlog refill, checkpoint cadence,
+        # timeout, graceful stop, state-count targets) could fire, and the
+        # two that can still land mid-era (timeout, SIGTERM) are handled
+        # by consuming the speculative era's real, sound work before
+        # stopping. Results are bit-identical to the serial driver either
+        # way; only the dispatch gap between eras disappears.
+        pipeline = self._pipeline and self._target_state_count is None
 
         while not stop and (count > 0 or self._spill):
             host_dirty = params_dev is None
@@ -1387,13 +1504,17 @@ class TpuBfsChecker(HostEngineBase):
                 host_dirty = True
             grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - vcap)
 
-            max_steps = max_sync
+            # The era budget is the device-emitted one (== max_sync
+            # verbatim when adaptivity is off), host-clamped to the wall-
+            # clock cap; a host override of either the budget or the cap
+            # is a param change the feedback path cannot carry.
+            max_steps = min(budget, budget_cap) if adaptive else budget
             if self._target_state_count is not None:
                 # Bound overshoot past the state-count target: each step
                 # generates at most C*A states.
                 remaining = max(0, self._target_state_count - self._state_count)
                 max_steps = max(1, min(max_steps, 1 + remaining // max(1, C * A)))
-            if max_steps != last_max_steps:
+            if max_steps != budget or budget_cap != last_budget_cap:
                 host_dirty = True
 
             if host_dirty:
@@ -1415,11 +1536,13 @@ class TpuBfsChecker(HostEngineBase):
                     fin_any,
                     fin_all,
                     fin_all_en,
+                    budget_cap,
                 ]
                 params_in = jnp.asarray(arr)
             else:
                 params_in = params_dev
             last_max_steps = max_steps
+            last_budget_cap = budget_cap
 
             _t0 = time.monotonic()
             self._era_t0 = _t0
@@ -1430,14 +1553,91 @@ class TpuBfsChecker(HostEngineBase):
                 f"block dirty={host_dirty} max_steps={max_steps} "
                 f"dispatch={time.monotonic() - _t0:.3f}s"
             )
+            spec_params = None
             try:
-                process_result()
+                while True:
+                    if not (
+                        pipeline
+                        and not self._spill
+                        and not self._ckpt_stop.is_set()
+                        and not self._timed_out()
+                        and (
+                            self._ckpt_every is None
+                            or time.monotonic() - self._last_ckpt
+                            < self._ckpt_every
+                        )
+                    ):
+                        # Serial boundary: consume the in-flight era with
+                        # full host services (spill, checkpoint, stop).
+                        process_result()
+                        break
+                    # Kick the era-N readback without blocking, then chain
+                    # era N+1 off the on-device state (params and rec_fp
+                    # are NOT donated, so the readback source stays live).
+                    try:
+                        params_dev.copy_to_host_async()
+                    except AttributeError:
+                        pass  # CPU backend: the copy below is free anyway
+                    spec_t0 = time.monotonic()
+                    table, queue, rec_fp1, rec_fp2, spec_params = self._loop(
+                        table, queue, rec_fp1, rec_fp2, params_dev
+                    )
+                    self._metrics.inc("spec_dispatch")
+                    process_result(spec_in_flight=True)
+                    if (
+                        not stop
+                        and count > 0
+                        and not self._spill
+                        and params_dev is not None
+                        and self._unique + vcap <= vs.MAX_LOAD * self._tcap
+                    ):
+                        # Era N ended inside every gate: the speculative
+                        # era IS era N+1 and has been executing since era
+                        # N's readback completed. Marginal timing anchor:
+                        # readback-to-readback, so the overlapped dispatch
+                        # books as device time, not host gap.
+                        params_dev = spec_params
+                        spec_params = None
+                        last_max_steps = budget
+                        self._era_t0 = time.monotonic()
+                        continue
+                    # Host action at this boundary. A device-visible exit
+                    # (spill, grow, fin, empty frontier) made the chained
+                    # era an identity no-op — account it as wasted
+                    # speculation, keep its (value-identical) outputs, and
+                    # fall back to the serial path. A host-ONLY stop
+                    # (timeout, SIGTERM) can land mid-chain instead; the
+                    # speculative era then ran real, sound work — consume
+                    # it normally before stopping.
+                    spec, spec_params = spec_params, None
+                    self._era_t0 = spec_t0  # overlap-aware if it ran
+                    if int(np.asarray(spec)[P_STEPS]) == 0:
+                        self._metrics.inc("spec_wasted")
+                        self._era_t0 = None
+                        if params_dev is not None:
+                            params_dev = spec  # chain tail (value-equal)
+                        break
+                    params_dev = spec
+                    last_max_steps = budget
+                    process_result()
+                    break
             except _ProbeBudgetExhausted:
                 # Graceful degradation (degraded_regrow): discard the failed
                 # era, reload the last crash-safe checkpoint (the pre-era
                 # state), double the table, and continue — instead of
                 # aborting the whole run. Only possible with a checkpoint:
                 # the consumed frontier rows are otherwise gone.
+                if spec_params is not None:
+                    # A chained era was in flight. A REAL probe error is
+                    # device-visible (err_cnt seeds from P_ERR), so the
+                    # chained era was an identity no-op; a chaos-injected
+                    # fake may have let it run real work. Either way the
+                    # checkpoint reload below discards its buffers
+                    # wholesale — just quiesce the dispatch and count the
+                    # speculation as wasted.
+                    np.asarray(spec_params)
+                    spec_params = None
+                    self._metrics.inc("spec_wasted")
                 from .common import checkpoint_generations
 
                 if (
@@ -1574,8 +1774,10 @@ class TpuBfsChecker(HostEngineBase):
             "rec_fp1": np.asarray(rec_fp1),
             "rec_fp2": np.asarray(rec_fp2),
         }
-        for t in range(4):
-            arrays[f"table{t}"] = np.asarray(table[t])
+        # On-disk format keeps the four flat lanes (table0..3); the packed
+        # key buffer is split host-side (free views over one download).
+        for t, lane in enumerate(vs.unpack_lanes_np(table)):
+            arrays[f"table{t}"] = lane
         for w, lane in enumerate(queue):
             arrays[f"queue{w}"] = np.asarray(lane)
         for i, blk in enumerate(self._spill):
@@ -1625,7 +1827,7 @@ class TpuBfsChecker(HostEngineBase):
                 key=lambda s: int(s[5:]),
             )
         ]
-        table = tuple(jnp.asarray(data[f"table{t}"]) for t in range(4))
+        table = vs.pack_lanes(*(data[f"table{t}"] for t in range(4)))
         queue = tuple(jnp.asarray(data[f"queue{w}"]) for w in range(W))
         return (
             table,
@@ -1666,10 +1868,14 @@ class TpuBfsChecker(HostEngineBase):
         if not hasattr(self, "_table_np"):
             import jax.numpy as jnp
 
-            # Stack on device, download ONCE (per-lane downloads cost a
-            # ~100ms round-trip each on this platform).
-            stacked = np.asarray(jnp.stack(self._table_dev))
-            self._table_np = tuple(stacked[t] for t in range(4))
+            # Concatenate on device, download ONCE (per-lane downloads cost
+            # a ~100ms round-trip each on this platform), then split into
+            # the four flat lanes lookup_parent_np walks.
+            flat = np.asarray(jnp.concatenate(self._table_dev))
+            cap = flat.shape[0] // 4
+            self._table_np = tuple(
+                flat[t * cap:(t + 1) * cap] for t in range(4)
+            )
         chain = [fp64]
         cur = fp64
         for _ in range(10_000_000):

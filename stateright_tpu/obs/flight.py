@@ -19,16 +19,25 @@ The load-bearing split is per-era wall time into
 ROADMAP item 1 claims the engines are dispatch/launch-bound, not
 bandwidth-bound; ``host_gap_secs`` is the direct per-era measurement of
 that claim, and the instrument any mega-era/dispatch-pipelining work
-must attribute its gains against. By construction
-``device_era_secs + host_gap_secs == wall_secs`` for every record (the
-gap is clamped at zero, so a clock hiccup can shrink the gap but never
-make the pair exceed the wall), and bench.py asserts the run-level sum
-reconciles with the externally timed wall clock within 5%.
+must attribute its gains against. The accounting is OVERLAP-AWARE: with
+speculative era pipelining the engine reports each era's MARGINAL
+device time (readback-to-readback), but a clock skew or an engine that
+reports a device span larger than the wall delta since the previous
+record books the excess as ``overlap_secs`` instead of silently
+clamping. By construction every record satisfies
+
+    device_era_secs - overlap_secs + host_gap_secs == wall_secs
+
+(both the gap and the overlap are clamped at zero, exactly one of them
+is nonzero), and bench.py asserts the run-level
+``device - overlap + gap`` sum reconciles with the externally timed
+wall clock within 5%.
 
 One record per era::
 
     {"era": 17, "ts": 3.71, "wall_secs": 0.21,
      "device_era_secs": 0.19, "host_gap_secs": 0.02,
+     "overlap_secs": 0.0,
      "steps": 12, "generated": 48210, "unique": 181032,
      "frontier": 52104, "load_factor": 0.173, "take_cap": 6144,
      "spill_rows": 0, "refill_rows": 0, "table_growths": 0,
@@ -80,6 +89,7 @@ class FlightRecorder:
         self._wall0 = None  # epoch pair of _t_start (Chrome ts alignment)
         self._device_secs = 0.0
         self._gap_secs = 0.0
+        self._overlap_secs = 0.0
         self._wall_secs = 0.0
 
     def start(self, t=None):
@@ -121,6 +131,11 @@ class FlightRecorder:
                 self._wall0 = time.time() - device
             wall = max(0.0, now - self._t_last)
             gap = max(0.0, wall - device)
+            # Overlap-aware split: device time in excess of the wall delta
+            # (a pipelined engine's dispatch overlapping the previous
+            # readback, or a clock hiccup) is booked explicitly rather
+            # than clamped away, keeping device-overlap+gap == wall exact.
+            overlap = max(0.0, device - wall)
             self._t_last = now
             self._eras += 1
             rec = {
@@ -129,6 +144,7 @@ class FlightRecorder:
                 "wall_secs": round(wall, 6),
                 "device_era_secs": round(device, 6),
                 "host_gap_secs": round(gap, 6),
+                "overlap_secs": round(overlap, 6),
                 "steps": int(steps),
                 "generated": int(generated),
                 "unique": int(unique),
@@ -147,6 +163,7 @@ class FlightRecorder:
             self._ring.append(rec)
             self._device_secs += device
             self._gap_secs += gap
+            self._overlap_secs += overlap
             self._wall_secs += wall
             return rec
 
@@ -170,6 +187,7 @@ class FlightRecorder:
                 "capacity": self.capacity,
                 "device_secs": round(self._device_secs, 6),
                 "host_gap_secs": round(self._gap_secs, 6),
+                "overlap_secs": round(self._overlap_secs, 6),
                 "wall_secs": round(wall, 6),
                 "host_gap_pct": (
                     round(100.0 * self._gap_secs / wall, 2) if wall else 0.0

@@ -3,13 +3,21 @@
 TPU-native replacement for the reference BFS's concurrent visited map
 (DashMap<Fingerprint, Option<Fingerprint>> at src/checker/bfs.rs:29-30).
 Fingerprints are (h1, h2) uint32 pairs (64-bit effective, nonzero as a
-pair). The table is structure-of-arrays: four dense [capacity] uint32
-arrays (key_h1, key_h2, parent_h1, parent_h2), with the all-zero key pair
-meaning "empty" and parent (0, 0) meaning "no parent" (initial state) —
-mirroring the reference's Option<Fingerprint> parent pointers used for
-path reconstruction (bfs.rs:380-409). SoA matters: a [capacity, 4] row
-table makes every gather/scatter move 4-wide rows that waste the TPU's
-8x128 vector tiles (measured >1000x slower than four flat 1-D accesses).
+pair). The table is structure-of-arrays: a paired-lane key buffer
+`keys[2 * capacity]` (slot i's h1 word at `keys[i]`, its h2 word at
+`keys[capacity + i]`) plus two dense [capacity] parent lanes (parent_h1,
+parent_h2), with the all-zero key pair meaning "empty" and parent (0, 0)
+meaning "no parent" (initial state) — mirroring the reference's
+Option<Fingerprint> parent pointers used for path reconstruction
+(bfs.rs:380-409). SoA matters: a [capacity, 4] row table makes every
+gather/scatter move 4-wide rows that waste the TPU's 8x128 vector tiles
+(measured >1000x slower than flat 1-D accesses). The paired-lane key
+buffer goes one further: each probe round reads BOTH key words with ONE
+gather over the concatenated index vector [idx, capacity + idx] (and
+claims them with one scatter), halving the dependent-gather chain that
+dominates insert cost. The on-disk checkpoint format keeps the original
+four flat lanes (table0..3); the engines split/concat the key buffer at
+the save/load boundary, so checkpoint meta geometry is unchanged.
 
 Probing is DOUBLE HASHING: slot_0 = h1 & mask, stride = h2 | 1 (odd, so it
 cycles the whole power-of-two table). Unlike linear probing there is no
@@ -105,37 +113,78 @@ MAX_LOAD = 0.25
 
 
 def empty_table(capacity: int):
-    """Four [capacity] uint32 zero lanes; capacity must be a power of two."""
+    """Packed zero table: (keys[2*capacity], parent_h1[capacity],
+    parent_h2[capacity]); capacity must be a power of two."""
     if capacity & (capacity - 1):
         raise ValueError("visited-set capacity must be a power of two")
-    # Four distinct buffers (not one aliased zeros array): the lanes are
+    # Distinct buffers (not one aliased zeros array): the lanes are
     # donated independently by the jitted insert/loop programs.
-    return tuple(jnp.zeros(capacity, dtype=jnp.uint32) for _ in range(4))
+    return (
+        jnp.zeros(2 * capacity, dtype=jnp.uint32),
+        jnp.zeros(capacity, dtype=jnp.uint32),
+        jnp.zeros(capacity, dtype=jnp.uint32),
+    )
 
 
 def table_capacity(table) -> int:
-    return table[0].shape[0]
+    return table[1].shape[0]
 
 
-def _probe_rounds(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
-    """One counted phase of the claim protocol over one candidate set."""
-    k1, k2, v1, v2 = table
-    capacity = k1.shape[0]
-    mask = jnp.uint32(capacity - 1)
+def pack_lanes(k1, k2, v1, v2):
+    """Build the packed device table from four flat key/parent lanes (the
+    checkpoint / host-seeding representation)."""
+    return (
+        jnp.concatenate([jnp.asarray(k1), jnp.asarray(k2)]),
+        jnp.asarray(v1),
+        jnp.asarray(v2),
+    )
+
+
+def unpack_lanes_np(table):
+    """Download a packed device table into the four flat numpy lanes used
+    by checkpoints and `lookup_parent_np` (key halves are free views)."""
+    import numpy as np
+
+    keys = np.asarray(table[0])
+    cap = keys.shape[0] // 2
+    return keys[:cap], keys[cap:], np.asarray(table[1]), np.asarray(table[2])
+
+
+def _probe_rounds(table, claim, h1, h2, p1, p2, idx, done, is_new, rounds):
+    """One counted phase of the claim protocol over one candidate set.
+
+    The probe stride is DERIVED here (`h2 | 1`) rather than passed in:
+    every probe sequence in this module uses the same double-hashing
+    stride, so deriving it from the gathered h2 words keeps the tail-stage
+    cascade free of a per-stage stride gather (loop-invariant hoisting).
+    """
+    keys, v1, v2 = table
+    capacity = v1.shape[0]
+    u = jnp.uint32
+    mask = u(capacity - 1)
     claim_cap = claim.shape[0]
-    cmask = jnp.uint32(claim_cap - 1)
+    cmask = u(claim_cap - 1)
     n = h1.shape[0]
-    my_id = jnp.arange(n, dtype=jnp.uint32)
+    my_id = jnp.arange(n, dtype=u)
+    stride = h2 | u(1)
     # The claim scratch and the table have DIFFERENT sizes, so each needs
     # its own out-of-bounds drop-target range (an index that is OOB for
-    # the claim would land INSIDE the larger table and corrupt it).
-    claim_oob = jnp.uint32(claim_cap) + my_id
-    table_oob = jnp.uint32(capacity) + my_id
+    # the claim would land INSIDE the larger table and corrupt it). For
+    # the packed [2*capacity] key buffer the drop targets start at
+    # 2*capacity — `capacity + my_id` would land inside the h2 half — and
+    # the two key-scatter halves get DISJOINT ranges ([2c, 2c+n) and
+    # [2c+n, 2c+2n)) so the concatenated scatter keeps unique indices.
+    claim_oob = u(claim_cap) + my_id
+    table_oob = u(2 * capacity) + my_id
+    table_oob2 = u(2 * capacity) + u(n) + my_id
+    hcap = u(capacity)
 
     def body(_r, carry):
-        k1, k2, v1, v2, claim, idx, done, is_new = carry
-        rk1 = k1[idx]
-        rk2 = k2[idx]
+        keys, v1, v2, claim, idx, done, is_new = carry
+        # ONE gather reads both key words: h1 at idx, h2 at capacity+idx.
+        rk = keys[jnp.concatenate([idx, hcap + idx])]
+        rk1 = rk[:n]
+        rk2 = rk[n:]
         slot_match = (rk1 == h1) & (rk2 == h2)
         done = done | slot_match  # already visited (or in-batch dup winner)
         slot_empty = (rk1 == 0) & (rk2 == 0)
@@ -153,10 +202,13 @@ def _probe_rounds(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, round
         claim = claim.at[jnp.where(want, ci, claim_oob)].set(my_id, mode="drop")
         won = want & (claim[ci] == my_id)
         # Winner slots are unique; losers/dones get distinct out-of-bounds
-        # targets so the unique-indices fast path stays valid.
+        # targets so the unique-indices fast path stays valid. Both key
+        # words land with ONE scatter over the concatenated targets.
         tgt = jnp.where(won, idx, table_oob)
-        k1 = k1.at[tgt].set(h1, mode="drop", unique_indices=True)
-        k2 = k2.at[tgt].set(h2, mode="drop", unique_indices=True)
+        tgt2 = jnp.where(won, hcap + idx, table_oob2)
+        keys = keys.at[jnp.concatenate([tgt, tgt2])].set(
+            jnp.concatenate([h1, h2]), mode="drop", unique_indices=True
+        )
         v1 = v1.at[tgt].set(p1, mode="drop", unique_indices=True)
         v2 = v2.at[tgt].set(p2, mode="drop", unique_indices=True)
         is_new = is_new | won
@@ -170,13 +222,13 @@ def _probe_rounds(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, round
         # become nearly free.
         advance = ~done & ~slot_empty
         idx = jnp.where(advance, (idx + stride) & mask, idx)
-        idx = jnp.where(done, jnp.uint32(0), idx)
-        return k1, k2, v1, v2, claim, idx, done, is_new
+        idx = jnp.where(done, u(0), idx)
+        return keys, v1, v2, claim, idx, done, is_new
 
     out = lax.fori_loop(
-        0, rounds, body, (k1, k2, v1, v2, claim, idx, done, is_new)
+        0, rounds, body, (keys, v1, v2, claim, idx, done, is_new)
     )
-    return (out[0], out[1], out[2], out[3]), out[4], out[5], out[6], out[7]
+    return (out[0], out[1], out[2]), out[3], out[4], out[5], out[6]
 
 
 def _compact_ids(mask, cap: int):
@@ -203,14 +255,14 @@ def _compact_ids(mask, cap: int):
     return ids, valid, n_set
 
 
-def _probe_all(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
+def _probe_all(table, claim, h1, h2, p1, p2, idx, done, is_new, rounds):
     """Primary probe rounds, then a cascade of gated straggler stages at
     narrowing widths. Returns (table, claim, done, is_new)."""
     u = jnp.uint32
     n = h1.shape[0]
 
     table, claim, idx, done, is_new = _probe_rounds(
-        table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds
+        table, claim, h1, h2, p1, p2, idx, done, is_new, rounds
     )
 
     for stage_rounds, stage_cap in TAIL_STAGES:
@@ -229,7 +281,8 @@ def _probe_all(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
             th2 = h2[tail_ids]
             tp1 = p1[tail_ids]
             tp2 = p2[tail_ids]
-            t_stride = stride[tail_ids]
+            # No per-stage stride gather: _probe_rounds re-derives the
+            # stride from the gathered th2 words (loop-invariant hoist).
             t_idx = jnp.where(t_valid, idx[tail_ids], u(0))
             t_done = ~t_valid
             # All-false but derived from varying data so the loop carry
@@ -237,7 +290,7 @@ def _probe_all(table, claim, h1, h2, p1, p2, stride, idx, done, is_new, rounds):
             # be unvarying).
             t_new = t_valid & ~t_valid
             table, claim, t_idx, t_done, t_new = _probe_rounds(
-                table, claim, th1, th2, tp1, tp2, t_stride, t_idx, t_done,
+                table, claim, th1, th2, tp1, tp2, t_idx, t_done,
                 t_new, stage_rounds,
             )
             # Fold the stage's results back into the full-width masks; the
@@ -285,7 +338,7 @@ def insert(table, h1, h2, p1, p2, active, rcap: int | None = None,
     traffic then scales with the number of distinct candidates instead of
     the padded batch width).
     """
-    capacity = table[0].shape[0]
+    capacity = table_capacity(table)
     u = jnp.uint32
     mask = u(capacity - 1)
     n = h1.shape[0]
@@ -302,12 +355,11 @@ def insert(table, h1, h2, p1, p2, active, rcap: int | None = None,
     claim = jnp.zeros(claim_cap, dtype=u) + (h1[0] & u(0))
 
     if rcap is None:
-        stride = h2 | u(1)
         # Inactive candidates start pinned at slot 0 (coalesced masked
         # gathers); see the pinning note in _probe_rounds.
         idx = jnp.where(active, h1 & mask, u(0))
         table, _claim, done, is_new = _probe_all(
-            table, claim, h1, h2, p1, p2, stride, idx, ~active,
+            table, claim, h1, h2, p1, p2, idx, ~active,
             jnp.zeros_like(active), primary_rounds,
         )
         return table, is_new, active & ~done, u(0)
@@ -318,10 +370,9 @@ def insert(table, h1, h2, p1, p2, active, rcap: int | None = None,
     ch2 = h2[cids]
     cp1 = p1[cids]
     cp2 = p2[cids]
-    c_stride = ch2 | u(1)
     c_idx = jnp.where(cvalid, ch1 & mask, u(0))
     table, _claim, c_done, c_new = _probe_all(
-        table, claim, ch1, ch2, cp1, cp2, c_stride, c_idx, ~cvalid,
+        table, claim, ch1, ch2, cp1, cp2, c_idx, ~cvalid,
         cvalid & ~cvalid, primary_rounds,
     )
     # Scatter results back to the full-width domain.
@@ -349,10 +400,11 @@ def lookup_parent(table, h1, h2):
     rare host-side queries (prefer `lookup_parent_np` on a downloaded
     table for chain walks).
     """
-    k1, k2, v1, v2 = table
-    capacity = k1.shape[0]
+    keys, v1, v2 = table
+    capacity = v1.shape[0]
     u = jnp.uint32
     mask = u(capacity - 1)
+    hcap = u(capacity)
     stride = h2 | u(1)
     idx = h1 & mask
     done = jnp.zeros(h1.shape, dtype=bool)
@@ -362,8 +414,8 @@ def lookup_parent(table, h1, h2):
 
     def body(_r, carry):
         idx, done, found, par1, par2 = carry
-        rk1 = k1[idx]
-        rk2 = k2[idx]
+        rk1 = keys[idx]
+        rk2 = keys[hcap + idx]
         slot_empty = (rk1 == 0) & (rk2 == 0)
         slot_match = (rk1 == h1) & (rk2 == h2)
         hit = ~done & slot_match
@@ -382,7 +434,8 @@ def lookup_parent(table, h1, h2):
 
 def occupied_mask(table):
     """Mask of nonempty slots — used when rehashing into a larger table."""
-    return (table[0] != 0) | (table[1] != 0)
+    cap = table_capacity(table)
+    return (table[0][:cap] != 0) | (table[0][cap:] != 0)
 
 
 def rehash(old_table, new_table):
@@ -392,7 +445,10 @@ def rehash(old_table, new_table):
     through the host). Returns (new_table, n_unresolved).
     """
     occ = occupied_mask(old_table)
-    k1, k2, v1, v2 = old_table
+    cap = table_capacity(old_table)
+    k1 = old_table[0][:cap]
+    k2 = old_table[0][cap:]
+    v1, v2 = old_table[1], old_table[2]
     # A rehash inserts millions of rows at once; use a deeper primary phase
     # so the fixed-size tail only sees genuine stragglers.
     new_table, _is_new, unresolved, _ovf = insert(

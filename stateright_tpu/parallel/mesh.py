@@ -62,7 +62,9 @@ P_TAKE_CAP = 12  # persisted across eras (self-tuned on bucket overflow)
 P_FIN_ANY = 13  # era exits when (global rec & fin_any) != 0
 P_FIN_ALL = 14  # era exits when fin_all_en and (rec & fin_all) == fin_all
 P_FIN_ALL_EN = 15
-P_LEN = 16
+P_BUDGET_CAP = 16  # upper clamp for the device-adaptive step budget;
+# 0 = adaptivity OFF (P_MAX_STEPS passes through unchanged)
+P_LEN = 17
 
 #: Cross-shard frontier imbalance (max/mean occupancy) above which the
 #: engine logs a skew warning once per run. Hash-based ownership keeps
@@ -131,6 +133,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         fin_any = params[P_FIN_ANY]
         fin_all = params[P_FIN_ALL]
         fin_all_en = params[P_FIN_ALL_EN]
+        budget_cap = params[P_BUDGET_CAP]
 
         def global_gates(count, unique, err_cnt, hseen, rec_acc0, its):
             """One stacked psum produces every exit condition, IDENTICAL on
@@ -346,10 +349,14 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         # Scalars seeded from varying data so carry types stay consistent
         # under shard_map (constants would be unvarying on the mesh axis).
         vzero = params[0] & u(0)
+        # err seeds from P_ERR (like engines/tpu_bfs.py): a chained
+        # (speculative) dispatch off a probe-error era re-derives the
+        # error exit and becomes an identity no-op instead of running on
+        # a table with dropped states.
         g0 = global_gates(
             params[P_COUNT],
             params[P_UNIQUE],
-            vzero,
+            params[P_ERR],
             tuple(false_lane for _ in range(NP_)),
             rec_bits,
             vzero,
@@ -372,7 +379,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             params[P_UNIQUE],
             vzero,
             vzero,
-            vzero,
+            params[P_ERR],  # carried: closes the gate on a chained dispatch
             jnp.minimum(jnp.maximum(params[P_TAKE_CAP], u(1)), u(chunk)),
             tuple(false_lane for _ in range(NP_)),
             tuple(zero_lane for _ in range(NP_)),
@@ -384,7 +391,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         )
         (
             table, queue, head, count, unique, gen, steps, err_cnt,
-            take_cap_out, hseen, facc1, facc2, faccd, covc_out, _its, _gc,
+            take_cap_out, hseen, facc1, facc2, faccd, covc_out, its_out, _gc,
         ) = lax.while_loop(cond, body, init)
 
         # Block epilogue (once per block): BLOCK-LOCAL discovery reports.
@@ -411,13 +418,62 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         maxd = jnp.where(
             steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
         )
+        # Adaptive era budget (device-side emission, mirroring
+        # engines/tpu_bfs.py): every input to the formula is globally
+        # uniform (one epilogue psum for pressure/err/work/global rec
+        # bits; `its_out` runs lockstep), so every shard emits the SAME
+        # next budget and a chained dispatch stays uniform too. The cost
+        # is one collective per BLOCK, not per step.
+        glocal = [
+            ((count > high_water) | (unique > grow_limit)).astype(u),
+            (err_cnt > u(0)).astype(u),
+            (count > u(0)).astype(u),
+        ] + [
+            jnp.minimum(hseen[pi].sum(dtype=u), u(1)) for pi in range(NP_)
+        ]
+        gb = lax.psum(jnp.stack(glocal), axis)
+        g_pressure = gb[0] > u(0)
+        g_err = gb[1] > u(0)
+        g_work = gb[2] > u(0)
+        rec_all = rec_bits
+        for pi in range(NP_):
+            rec_all = rec_all | (jnp.minimum(gb[3 + pi], u(1)) << u(pi))
+        fin_hit_final = ((rec_all & fin_any) != u(0)) | (
+            (fin_all_en != u(0)) & ((rec_all & fin_all) == fin_all)
+        )
+        budget_only = (
+            (its_out >= max_steps)
+            & g_work
+            & ~g_pressure
+            & ~g_err
+            & ~fin_hit_final
+        )
+        grown = jnp.minimum(jnp.maximum(max_steps, u(1)) * u(2), budget_cap)
+        shrunk = jnp.maximum(
+            jnp.minimum(max_steps, budget_cap) >> u(1),
+            u(64),  # BUDGET_MIN (engines/tpu_bfs.py)
+        )
+        next_budget = jnp.where(
+            budget_cap == u(0),
+            max_steps,
+            jnp.where(
+                g_pressure, shrunk,
+                jnp.where(budget_only, grown, max_steps),
+            ),
+        )
+        # P_REC emits the GLOBAL accumulated bits (rec_all), not the
+        # shard-local rec_bits_out: the host ORs the rows anyway, and a
+        # chained (speculative) dispatch feeds the row straight back in —
+        # shard-local bits would make the finish gate non-uniform across
+        # shards and deadlock the lockstep collectives. Per-shard
+        # discovery attribution rides disc_depth, not this word.
         parts = [
             jnp.stack(
                 [
-                    head, count, unique, rec_bits_out, depth_limit,
-                    grow_limit, high_water, max_steps, gen, maxd, steps,
+                    head, count, unique, rec_all, depth_limit,
+                    grow_limit, high_water, next_budget, gen, maxd, steps,
                     (err_cnt > 0).astype(u), take_cap_out,
-                    fin_any, fin_all, fin_all_en,
+                    fin_any, fin_all, fin_all_en, budget_cap,
                 ]
             )
         ]
@@ -738,8 +794,8 @@ def _build_grow(old_cap: int, new_cap: int, mesh, axis: str):
         get_shard_map()(
             per_device,
             mesh=mesh,
-            in_specs=((spec,) * 4,),
-            out_specs=((spec,) * 4, spec),
+            in_specs=((spec,) * 3,),
+            out_specs=((spec,) * 3, spec),
         ),
         donate_argnums=donate_argnums_safe(0),
     )
@@ -818,6 +874,10 @@ class ShardedBfsChecker(HostEngineBase):
         self._cov = self._coverage.enabled
         self._stage_profile = bool(getattr(builder, "stage_profile_", False))
         self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
+        # Speculative era pipelining (CheckerBuilder.pipeline(), default
+        # on) — see _run_loop and engines/tpu_bfs.py for the soundness
+        # argument.
+        self._pipeline = bool(getattr(builder, "pipeline_", True))
         self._block = _build_block(
             self.tm, self._tprops, self._chunk, self._qcap, self.n_shards,
             self._quota, self.mesh, "shards", self._cov,
@@ -937,7 +997,15 @@ class ShardedBfsChecker(HostEngineBase):
                 self._unique += 1
         self._coverage.record_depth(1, len(seen))
 
-        table = tuple(jnp.asarray(table_np[:, :, t]) for t in range(4))
+        # Pack the host-seeded 4-lane rows into the device table layout:
+        # per-shard key buffer [2*tcap] (h1 half | h2 half) + parent lanes.
+        table = (
+            jnp.asarray(
+                np.concatenate([table_np[:, :, 0], table_np[:, :, 1]], axis=1)
+            ),
+            jnp.asarray(table_np[:, :, 2]),
+            jnp.asarray(table_np[:, :, 3]),
+        )
         queue = tuple(jnp.asarray(queue_np[:, :, t]) for t in range(W))
         rec_fp1 = jnp.zeros((N, NP_), dtype=jnp.uint32)
         rec_fp2 = jnp.zeros((N, NP_), dtype=jnp.uint32)
@@ -985,6 +1053,22 @@ class ShardedBfsChecker(HostEngineBase):
             if self._timeout is None and self._ckpt_every is None
             else min(64, self._max_sync_steps)
         )
+        # Adaptive era budgets (see engines/tpu_bfs.py): the device epilogue
+        # emits the next era's budget through the P_MAX_STEPS output slot
+        # (globally uniform — computed from psum'd pressure bits), doubling
+        # after clean budget-only exits and halving under pressure. The host
+        # only steers the CAP by wall-clock feedback so checkpoint cadence
+        # and timeout polling hold.
+        adaptive = self._timeout is not None or self._ckpt_every is not None
+        budget = max_sync
+        budget_cap = min(64, max_sync) if adaptive else 0
+        cap_limit = min(self._max_sync_steps, 1 << 30)
+        poll_target = None
+        if self._ckpt_every is not None:
+            poll_target = self._ckpt_every / 4.0
+        if self._timeout is not None:
+            t = self._timeout / 4.0
+            poll_target = t if poll_target is None else min(poll_target, t)
         fin_any, fin_all, fin_all_en = self._finish_when.device_masks(
             self._tprops
         )
@@ -1007,84 +1091,34 @@ class ShardedBfsChecker(HostEngineBase):
         # and count again, so the identity is exact only for clean runs.
         flight_prev_unique = np.zeros(N, dtype=np.int64)
         imbalance_warned = False
+        stop = False
+        # Speculative era pipelining (tentpole; see engines/tpu_bfs.py for
+        # the full soundness argument): the block re-derives EVERY
+        # host-intervention exit from the chained params rows — count /
+        # high_water / grow_limit / GLOBAL rec bits / err (seeded from
+        # P_ERR) all close the uniform gate — so a block chained off a
+        # host-action boundary is an exact identity no-op. The chain is
+        # not entered while any host-ONLY concern (spill-backlog refill,
+        # checkpoint cadence, timeout, graceful stop, state-count target)
+        # could fire.
+        pipeline = self._pipeline and self._target_state_count is None
 
-        while counts.sum() > 0 or any(self._spill[s] for s in range(N)):
-            # Refill spills per shard (one batched upload per shard).
-            for s in range(N):
-                refill = []
-                refill_rows = 0
-                # Spill blocks are <= N*quota rows and spill_target >=
-                # 1.5*N*quota (qcap >= 4*N*quota in __init__), so an empty
-                # shard always refills at least one block.
-                while self._spill[s] and (
-                    counts[s] + refill_rows + len(self._spill[s][-1])
-                    <= spill_target
-                ):
-                    refill.append(self._spill[s].pop())
-                    refill_rows += len(refill[-1])
-                if refill:
-                    rows = np.concatenate(refill, axis=0)
-                    k = len(rows)
-                    idx = jnp.asarray(
-                        (heads[s] + counts[s] + np.arange(k)) & (self._qcap - 1)
-                    )
-                    with self._metrics.phase("refill"):
-                        rows_dev = jnp.asarray(rows)
-                        queue = tuple(
-                            queue[t].at[s, idx].set(rows_dev[:, t])
-                            for t in range(W)
-                        )
-                    counts[s] += k
-                    self._metrics.inc("refill_rows", k)
-            if counts.sum() == 0:
-                if any(self._spill[s] for s in range(N)):
-                    # Unreachable by the block-size invariant above; loud
-                    # beats silently dropping spilled states.
-                    raise RuntimeError("empty frontier with stranded spill")
-                break
-
-            # Grow ALL shard tables together when any shard nears the load
-            # limit (uniform shapes keep one compiled program).
-            while (
-                max(per_shard_unique) + N * self._quota
-                > vs.MAX_LOAD * self._tcap
-            ):
-                with self._metrics.phase("table_grow"):
-                    table = self._grow_tables(table)
-                self._metrics.inc("table_growths")
-            grow_limit = max(
-                0, int(vs.MAX_LOAD * self._tcap) - N * self._quota
-            )
-
-            max_steps = max_sync
-            if self._target_state_count is not None:
-                remaining = max(
-                    0, self._target_state_count - self._state_count
-                )
-                max_steps = max(
-                    1, min(max_steps, 1 + remaining // max(1, N * C * A))
-                )
-
-            params_np = np.zeros((N, P_LEN + ncov), dtype=np.uint32)
-            for s in range(N):
-                params_np[s, :P_LEN] = [
-                    heads[s], counts[s], per_shard_unique[s], rec_bits,
-                    depth_limit, grow_limit, high_water, max_steps,
-                    0, 0, 0, 0, take_caps[s],
-                    fin_any, fin_all, fin_all_en,
-                ]
-            _era_w0 = _time.monotonic()
-            with self._metrics.phase("device_era"):
-                table, queue, rec_fp1, rec_fp2, params, disc_depth = (
-                    self._block(
-                        table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
-                    )
-                )
-                with self._metrics.phase("readback"):
-                    vals = np.asarray(params)  # the one download per block
-            era_wall = _time.monotonic() - _era_w0
-            self._metrics.observe("era_secs", era_wall)
-
+        def consume(vals, fp1_dev, fp2_dev, dd_dev, era_wall, era_budget,
+                    spec_in_flight=False):
+            """Consume one block result: error recovery, counters,
+            discoveries, spill drain, telemetry, checkpoint cadence, and
+            stop conditions. Returns False when the era was discarded
+            (probe error -> degraded-regrow reload), True otherwise.
+            With ``spec_in_flight`` a chained block is still executing on
+            device: the checkpoint save is deferred to the next serial
+            boundary (the table/queue bindings here are the NEXT block's
+            output buffers — pairing this era's heads/counts with them is
+            only safe when that block is a no-op, which the caller cannot
+            know yet)."""
+            nonlocal table, queue, heads, counts, take_caps
+            nonlocal per_shard_unique, rec_bits, rec_fp1, rec_fp2
+            nonlocal budget, budget_cap, regrow_budget, disc_depth_best
+            nonlocal flight_prev_unique, imbalance_warned, stop
             err = bool(vals[:, P_ERR].any())
             if not err and self._chaos_probe_error_era is not None and (
                 self._metrics.get("eras") >= self._chaos_probe_error_era
@@ -1125,10 +1159,19 @@ class ShardedBfsChecker(HostEngineBase):
                     frontier=int(counts.sum()),
                     new_tcap=self._tcap,
                 )
-                continue
+                return False
             heads = vals[:, P_HEAD].astype(np.int64)
             counts = vals[:, P_COUNT].astype(np.int64)
             take_caps = list(vals[:, P_TAKE_CAP].astype(np.int64))
+            # Device-emitted next-era budget (uniform across shards — it is
+            # computed from psum'd inputs); the host steers only the cap.
+            budget = int(vals[0, P_MAX_STEPS])
+            self._metrics.set_gauge("era_step_budget", int(era_budget))
+            if poll_target is not None and era_wall > 0.0:
+                if era_wall < poll_target / 2 and budget_cap < cap_limit:
+                    budget_cap = min(budget_cap * 2, cap_limit)
+                elif era_wall > poll_target and budget_cap > 64:
+                    budget_cap = max(budget_cap // 2, 64)
             per_shard_unique = list(vals[:, P_UNIQUE].astype(np.int64))
             self._unique = int(sum(per_shard_unique))
             self._state_count += int(vals[:, P_GEN].sum())
@@ -1155,9 +1198,9 @@ class ShardedBfsChecker(HostEngineBase):
 
             block_bits = int(np.bitwise_or.reduce(vals[:, P_REC]))
             if block_bits:
-                fp1 = np.asarray(rec_fp1)
-                fp2 = np.asarray(rec_fp2)
-                depths = np.asarray(disc_depth)  # [N, NP_]
+                fp1 = np.asarray(fp1_dev)
+                fp2 = np.asarray(fp2_dev)
+                depths = np.asarray(dd_dev)  # [N, NP_]
                 for pi, p in enumerate(self._tprops):
                     if not (block_bits >> pi) & 1:
                         continue
@@ -1269,7 +1312,7 @@ class ShardedBfsChecker(HostEngineBase):
                 spill_rows=spilled,
             )
 
-            if self._ckpt_path is not None and (
+            if not spec_in_flight and self._ckpt_path is not None and (
                 self._ckpt_every is not None
                 and _time.monotonic() - self._last_ckpt >= self._ckpt_every
             ):
@@ -1279,9 +1322,10 @@ class ShardedBfsChecker(HostEngineBase):
                 )
 
             # Flight record after spill/checkpoint so this era's host work
-            # lands in its own host_gap. The mesh readback is nested inside
-            # the device_era phase, so era_wall (timed around the phase
-            # block above) is the device share directly.
+            # lands in its own host_gap. Under pipelining era_wall is the
+            # MARGINAL readback-to-readback span, so the summary still
+            # reconciles with the external wall clock (obs/flight.py
+            # overlap-aware accounting).
             self._flight_record(
                 device_era_secs=era_wall,
                 steps=int(vals[:, P_STEPS].sum()),
@@ -1297,19 +1341,182 @@ class ShardedBfsChecker(HostEngineBase):
             )
 
             if self._finish_matched(self._discovery_fps):
-                break
-            if (
+                stop = True
+            elif (
                 self._target_state_count is not None
                 and self._state_count >= self._target_state_count
             ):
-                break
-            if self._timed_out():
-                break
-            if self._ckpt_stop.is_set():
+                stop = True
+            elif self._timed_out():
+                stop = True
+            elif self._ckpt_stop.is_set():
                 # Graceful-stop request (SIGTERM/SIGINT flush): the final
                 # checkpoint below captures this era boundary — the same
                 # path timeout/target stops take.
                 self._metrics.set_gauge("interrupted", 1)
+                stop = True
+            return True
+
+        while not stop and (
+            counts.sum() > 0 or any(self._spill[s] for s in range(N))
+        ):
+            # Refill spills per shard (one batched upload per shard).
+            for s in range(N):
+                refill = []
+                refill_rows = 0
+                # Spill blocks are <= N*quota rows and spill_target >=
+                # 1.5*N*quota (qcap >= 4*N*quota in __init__), so an empty
+                # shard always refills at least one block.
+                while self._spill[s] and (
+                    counts[s] + refill_rows + len(self._spill[s][-1])
+                    <= spill_target
+                ):
+                    refill.append(self._spill[s].pop())
+                    refill_rows += len(refill[-1])
+                if refill:
+                    rows = np.concatenate(refill, axis=0)
+                    k = len(rows)
+                    idx = jnp.asarray(
+                        (heads[s] + counts[s] + np.arange(k)) & (self._qcap - 1)
+                    )
+                    with self._metrics.phase("refill"):
+                        rows_dev = jnp.asarray(rows)
+                        queue = tuple(
+                            queue[t].at[s, idx].set(rows_dev[:, t])
+                            for t in range(W)
+                        )
+                    counts[s] += k
+                    self._metrics.inc("refill_rows", k)
+            if counts.sum() == 0:
+                if any(self._spill[s] for s in range(N)):
+                    # Unreachable by the block-size invariant above; loud
+                    # beats silently dropping spilled states.
+                    raise RuntimeError("empty frontier with stranded spill")
+                break
+
+            # Grow ALL shard tables together when any shard nears the load
+            # limit (uniform shapes keep one compiled program).
+            while (
+                max(per_shard_unique) + N * self._quota
+                > vs.MAX_LOAD * self._tcap
+            ):
+                with self._metrics.phase("table_grow"):
+                    table = self._grow_tables(table)
+                self._metrics.inc("table_growths")
+            grow_limit = max(
+                0, int(vs.MAX_LOAD * self._tcap) - N * self._quota
+            )
+
+            max_steps = min(budget, budget_cap) if adaptive else budget
+            if self._target_state_count is not None:
+                remaining = max(
+                    0, self._target_state_count - self._state_count
+                )
+                max_steps = max(
+                    1, min(max_steps, 1 + remaining // max(1, N * C * A))
+                )
+
+            params_np = np.zeros((N, P_LEN + ncov), dtype=np.uint32)
+            for s in range(N):
+                params_np[s, :P_LEN] = [
+                    heads[s], counts[s], per_shard_unique[s], rec_bits,
+                    depth_limit, grow_limit, high_water, max_steps,
+                    0, 0, 0, 0, take_caps[s],
+                    fin_any, fin_all, fin_all_en, budget_cap,
+                ]
+            _era_w0 = _time.monotonic()
+            table, queue, rec_fp1, rec_fp2, params, disc_depth = self._block(
+                table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
+            )
+            cur_budget = max_steps
+            while True:
+                if not (
+                    pipeline
+                    and not any(self._spill[s] for s in range(N))
+                    and not self._ckpt_stop.is_set()
+                    and not self._timed_out()
+                    and (
+                        self._ckpt_every is None
+                        or _time.monotonic() - self._last_ckpt
+                        < self._ckpt_every
+                    )
+                ):
+                    # Serial boundary: block on the readback, consume with
+                    # full host services (spill drain, checkpoint, stop).
+                    with self._metrics.phase("readback"):
+                        vals = np.asarray(params)  # one download per block
+                    era_wall = _time.monotonic() - _era_w0
+                    self._metrics.add_phase("device_era", era_wall)
+                    self._metrics.observe("era_secs", era_wall)
+                    consume(vals, rec_fp1, rec_fp2, disc_depth, era_wall,
+                            cur_budget)
+                    break
+                # Kick block N's readback without blocking, then chain
+                # block N+1 off the on-device state. params / rec_fp /
+                # disc_depth are NOT donated, so the readback sources stay
+                # live; save the handles before rebinding — the mesh
+                # discovery path reads the fp/depth device arrays too.
+                try:
+                    params.copy_to_host_async()
+                except AttributeError:
+                    pass  # CPU backend: the copy below is free anyway
+                spec_t0 = _time.monotonic()
+                prev_params, prev_fp1, prev_fp2, prev_dd = (
+                    params, rec_fp1, rec_fp2, disc_depth,
+                )
+                table, queue, rec_fp1, rec_fp2, params, disc_depth = (
+                    self._block(table, queue, rec_fp1, rec_fp2, prev_params)
+                )
+                self._metrics.inc("spec_dispatch")
+                with self._metrics.phase("readback"):
+                    vals = np.asarray(prev_params)
+                era_wall = _time.monotonic() - _era_w0
+                self._metrics.add_phase("device_era", era_wall)
+                self._metrics.observe("era_secs", era_wall)
+                ok = consume(vals, prev_fp1, prev_fp2, prev_dd, era_wall,
+                             cur_budget, spec_in_flight=True)
+                if not ok:
+                    # Probe error -> checkpoint reload. The real-err case
+                    # makes the chained block a guaranteed no-op (the
+                    # carried P_ERR closes the gate); a chaos-faked err may
+                    # have let it run real work — either way the reload
+                    # discards the whole chain. Quiesce before dropping the
+                    # handles so the reload's uploads don't race the block.
+                    np.asarray(params)
+                    self._metrics.inc("spec_wasted")
+                    break
+                cur_budget = budget
+                if (
+                    not stop
+                    and counts.sum() > 0
+                    and not any(self._spill[s] for s in range(N))
+                    and max(per_shard_unique) + N * self._quota
+                    <= vs.MAX_LOAD * self._tcap
+                ):
+                    # Clean boundary: the chained block IS the next era.
+                    # grow_limit check mirrors the proactive-grow trigger
+                    # above, so a growth boundary always falls through to
+                    # the no-op discard below.
+                    _era_w0 = _time.monotonic()
+                    continue
+                # Host action at this boundary (stop request, drained
+                # frontier, spill backlog, or table growth due). Every
+                # DEVICE-visible case makes the chained block an identity
+                # no-op (see the soundness note above); peek its steps to
+                # tell. steps > 0 means a host-ONLY stop (timeout/SIGTERM)
+                # landed mid-chain while the device legitimately ran —
+                # consume that real, sound work before stopping.
+                svals = np.asarray(params)  # blocking: quiesce the chain
+                if int(svals[:, P_STEPS].sum()) == 0:
+                    # Identity no-op: outputs value-equal to inputs; keep
+                    # the rebound handles (same values) and discard.
+                    self._metrics.inc("spec_wasted")
+                    break
+                era_wall = _time.monotonic() - spec_t0
+                self._metrics.add_phase("device_era", era_wall)
+                self._metrics.observe("era_secs", era_wall)
+                consume(svals, rec_fp1, rec_fp2, disc_depth, era_wall,
+                        cur_budget)
                 break
 
         if self._ckpt_path is not None:
@@ -1406,8 +1613,14 @@ class ShardedBfsChecker(HostEngineBase):
             "rec_fp1": np.asarray(rec_fp1),
             "rec_fp2": np.asarray(rec_fp2),
         }
-        for t in range(4):
-            arrays[f"table{t}"] = np.asarray(table[t])
+        # On-disk format keeps the four flat lanes (table0..3) per shard;
+        # the packed key buffer is split host-side (views, one download).
+        keys = np.asarray(table[0])
+        cap = keys.shape[1] // 2
+        arrays["table0"] = keys[:, :cap]
+        arrays["table1"] = keys[:, cap:]
+        arrays["table2"] = np.asarray(table[1])
+        arrays["table3"] = np.asarray(table[2])
         for w, lane in enumerate(queue):
             arrays[f"queue{w}"] = np.asarray(lane)
         for s in range(self.n_shards):
@@ -1463,7 +1676,13 @@ class ShardedBfsChecker(HostEngineBase):
                 key=lambda n: int(n.rsplit("_", 1)[1]),
             )
             self._spill[s] = [data[k] for k in blocks]
-        table = tuple(jnp.asarray(data[f"table{t}"]) for t in range(4))
+        table = (
+            jnp.asarray(
+                np.concatenate([data["table0"], data["table1"]], axis=1)
+            ),
+            jnp.asarray(data["table2"]),
+            jnp.asarray(data["table3"]),
+        )
         queue = tuple(jnp.asarray(data[f"queue{w}"]) for w in range(W))
         return (
             table,
@@ -1538,7 +1757,16 @@ class ShardedBfsChecker(HostEngineBase):
         from ..ops import visited_set as vs
 
         if not hasattr(self, "_table_np"):
-            self._table_np = [np.asarray(l) for l in self._table_dev]
+            # Split the packed per-shard key buffer into the four flat
+            # lanes lookup_parent_np walks (views over one download each).
+            keys = np.asarray(self._table_dev[0])
+            cap = keys.shape[1] // 2
+            self._table_np = [
+                keys[:, :cap],
+                keys[:, cap:],
+                np.asarray(self._table_dev[1]),
+                np.asarray(self._table_dev[2]),
+            ]
         chain = [fp64]
         cur = fp64
         for _ in range(10_000_000):

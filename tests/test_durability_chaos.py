@@ -222,6 +222,37 @@ def test_request_checkpoint_stop_flushes_resumable_checkpoint(tmp_path):
     assert resumed.unique_state_count() == 8832
 
 
+def test_kill_resume_under_pipelining(tmp_path):
+    """Kill/resume with the speculative era driver engaged (ISSUE 14):
+    the partial run stops gracefully mid-pipeline (no checkpoint cadence,
+    so the chain gate stays open until the stop request closes it), and
+    the resumed run — also pipelined, with many short eras — must land
+    on the exact golden. A stop that arrives while a speculative era is
+    in flight either discards it (identity no-op) or consumes its real,
+    sound work; both end at a resumable era boundary."""
+    ckpt = str(tmp_path / "pipe.ckpt.npz")
+    opts = dict(OPTS, sync_steps=4)
+    checker = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_tpu_bfs(checkpoint_path=ckpt, **opts)
+    )
+    checker.request_checkpoint_stop()
+    checker.join()
+    assert checker.telemetry().get("interrupted") == 1
+    assert checker.unique_state_count() < 8832
+    assert os.path.exists(ckpt)
+    resumed = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_tpu_bfs(resume_from=ckpt, **opts)
+        .join()
+    )
+    assert resumed.unique_state_count() == 8832
+    # The resumed run actually exercised the speculative driver.
+    assert resumed.telemetry().get("spec_dispatch", 0) >= 1
+
+
 def test_sigterm_flushes_final_checkpoint(tmp_path):
     """The real kill path: SIGTERM to our own process while a checkpointing
     engine runs. The installed handler asks the engine to stop, the engine

@@ -39,27 +39,46 @@ def test_device_gap_wall_identity_per_record():
     recs = fr.records()
     assert [r["era"] for r in recs] == [1, 2]
     for r in recs:
-        assert r["device_era_secs"] + r["host_gap_secs"] == pytest.approx(
-            r["wall_secs"]
-        )
+        # The load-bearing overlap-aware identity, exact per record.
+        assert r["device_era_secs"] - r["overlap_secs"] + r[
+            "host_gap_secs"
+        ] == pytest.approx(r["wall_secs"])
+        assert r["overlap_secs"] == 0.0  # serial eras: no overlap
     assert recs[0]["host_gap_secs"] == pytest.approx(0.3)
     assert recs[1]["host_gap_secs"] == pytest.approx(0.1)
     s = fr.summary()
     assert s["eras"] == 2
     assert s["device_secs"] == pytest.approx(0.6)
     assert s["host_gap_secs"] == pytest.approx(0.4)
+    assert s["overlap_secs"] == 0.0
     assert s["wall_secs"] == pytest.approx(1.0)
     assert s["host_gap_pct"] == pytest.approx(40.0)
 
 
-def test_gap_clamped_when_device_exceeds_wall():
-    # A clock hiccup can make the measured device time exceed the wall
-    # delta; the gap clamps at zero so the pair never exceeds the wall.
+def test_overlap_booked_when_device_exceeds_wall():
+    # A pipelined engine can report a device span larger than the wall
+    # delta since the previous readback (its dispatch overlapped the
+    # previous era's host work). The excess is booked as overlap_secs —
+    # not silently clamped — so device - overlap + gap == wall stays
+    # exact and the run-level totals reconcile with the external clock.
     fr = FlightRecorder()
     fr.start(t=0.0)
     fr.record(device_era_secs=2.0, t=1.0)
-    rec = fr.records()[0]
-    assert rec["host_gap_secs"] == 0.0
+    fr.record(device_era_secs=0.5, t=2.0)  # serial era afterwards
+    recs = fr.records()
+    assert recs[0]["host_gap_secs"] == 0.0
+    assert recs[0]["overlap_secs"] == pytest.approx(1.0)
+    assert recs[0]["device_era_secs"] - recs[0]["overlap_secs"] + recs[0][
+        "host_gap_secs"
+    ] == pytest.approx(recs[0]["wall_secs"])
+    # Exactly one of gap/overlap is nonzero per record.
+    assert recs[1]["overlap_secs"] == 0.0
+    assert recs[1]["host_gap_secs"] == pytest.approx(0.5)
+    s = fr.summary()
+    assert s["overlap_secs"] == pytest.approx(1.0)
+    assert s["device_secs"] - s["overlap_secs"] + s[
+        "host_gap_secs"
+    ] == pytest.approx(s["wall_secs"])
 
 
 def test_lazy_anchor_without_start():
@@ -140,9 +159,11 @@ def test_device_run_records_flight_by_default(tmp_path):
     tel = c.telemetry()
     assert len(recs) == tel["eras"]
     for r in recs:
-        assert r["device_era_secs"] + r["host_gap_secs"] == pytest.approx(
-            r["wall_secs"]
-        )
+        # Overlap-aware identity: with pipelining ON (the default) a
+        # chained era's device span can overlap the previous host gap.
+        assert r["device_era_secs"] - r["overlap_secs"] + r[
+            "host_gap_secs"
+        ] == pytest.approx(r["wall_secs"])
         assert r["take_cap"] >= 1
     # The last record reconciles with the engine's own counters.
     assert recs[-1]["unique"] == c.unique_state_count()
@@ -151,9 +172,9 @@ def test_device_run_records_flight_by_default(tmp_path):
     # Summary rides telemetry, plus the flat Prometheus-visible gauges.
     fsum = tel["flight"]
     assert fsum["eras"] == len(recs)
-    assert fsum["device_secs"] + fsum["host_gap_secs"] == pytest.approx(
-        fsum["wall_secs"], rel=1e-6, abs=1e-6
-    )
+    assert fsum["device_secs"] - fsum["overlap_secs"] + fsum[
+        "host_gap_secs"
+    ] == pytest.approx(fsum["wall_secs"], rel=1e-6, abs=1e-6)
     assert tel["flight_eras"] == fsum["eras"]
     assert tel["flight_device_era_secs"] == pytest.approx(
         fsum["device_secs"]

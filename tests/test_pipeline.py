@@ -1,0 +1,213 @@
+"""Pipelined-vs-serial parity (ISSUE 14 tentpole): the speculative era
+driver must be GOLDEN-IDENTICAL to the serial one.
+
+A speculative era is dispatched off the still-on-device params chain
+before the host has read era N's result. The device cond re-derives
+every host-intervention exit from the chained params, so a speculative
+era dispatched across a host-action boundary is an exact identity no-op
+and the consumed stream of eras is the same either way. These tests pin
+that equivalence end to end on both device engines: unique counts,
+total state counts, max depth, discovery fingerprints, and coverage
+histograms — with pipelining forced OFF via ``CheckerBuilder.pipeline``
+against the default ON — plus the chaos path (a probe-error era with a
+speculative era in flight is discarded wholesale by the checkpoint
+reload and never corrupts the resumed run).
+"""
+
+import jax
+import pytest
+
+from stateright_tpu.models import TwoPhaseTensor
+from stateright_tpu.tensor import TensorModelAdapter
+
+# sync_steps=4 forces many short eras so speculative chains actually
+# engage (a run that finishes in one era never reaches a chain point).
+OPTS = dict(
+    chunk_size=64,
+    queue_capacity=1 << 12,
+    table_capacity=1 << 11,
+    sync_steps=4,
+)
+
+
+def _paxos_opts():
+    return dict(
+        chunk_size=1024,
+        queue_capacity=1 << 16,
+        table_capacity=1 << 16,
+        sync_steps=64,
+    )
+
+
+def _fingerprint(c):
+    """Everything the golden contract covers, in one comparable dict."""
+    cov = c.coverage()
+    return dict(
+        unique=c.unique_state_count(),
+        states=c.state_count(),
+        max_depth=c.max_depth(),
+        discovery_fps=dict(c._discovery_fps),
+        coverage_actions=cov["actions"],
+        coverage_depths=cov["depths"],
+    )
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    return devs[:4]
+
+
+def test_tpu_bfs_parity_2pc5():
+    runs = {}
+    for on in (True, False):
+        c = (
+            TensorModelAdapter(TwoPhaseTensor(5))
+            .checker()
+            .coverage()
+            .pipeline(on)
+            .spawn_tpu_bfs(**OPTS)
+            .join()
+        )
+        c.assert_properties()
+        runs[on] = (_fingerprint(c), c.telemetry())
+    fp_on, tel_on = runs[True]
+    fp_off, tel_off = runs[False]
+    assert fp_on["unique"] == 8832
+    assert fp_on == fp_off
+    # The pipelined run actually speculated; the serial run never did.
+    assert tel_on.get("spec_dispatch", 0) >= 1
+    assert tel_off.get("spec_dispatch", 0) == 0
+
+
+def test_tpu_bfs_parity_paxos2():
+    from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+    runs = {}
+    for on in (True, False):
+        c = (
+            TensorModelAdapter(PaxosTensorExhaustive(2))
+            .checker()
+            .coverage()
+            .pipeline(on)
+            .spawn_tpu_bfs(**_paxos_opts())
+            .join()
+        )
+        runs[on] = (_fingerprint(c), c.telemetry())
+    fp_on, tel_on = runs[True]
+    fp_off, _ = runs[False]
+    assert fp_on["unique"] == 16_668
+    assert fp_on == fp_off
+    assert "value chosen" in fp_on["discovery_fps"]
+    assert tel_on.get("spec_dispatch", 0) >= 1
+
+
+def test_mesh_parity_2pc5(devices):
+    runs = {}
+    opts = dict(
+        devices=devices,
+        chunk_size=64,
+        queue_capacity_per_shard=1 << 11,
+        table_capacity_per_shard=1 << 10,
+        sync_steps=4,
+    )
+    for on in (True, False):
+        c = (
+            TensorModelAdapter(TwoPhaseTensor(5))
+            .checker()
+            .coverage()
+            .pipeline(on)
+            .spawn_sharded_bfs(**opts)
+            .join()
+        )
+        runs[on] = (_fingerprint(c), c.telemetry())
+    fp_on, tel_on = runs[True]
+    fp_off, tel_off = runs[False]
+    assert fp_on["unique"] == 8832
+    assert fp_on == fp_off
+    assert tel_on.get("spec_dispatch", 0) >= 1
+    assert tel_off.get("spec_dispatch", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos: a probe-error era with a speculative era in flight
+# ---------------------------------------------------------------------------
+#
+# The degraded-regrow path (reload last checkpoint, double the table,
+# continue) must discard the WHOLE chain: the error era's unsound work
+# and whatever the speculative era did. A real probe error closes the
+# chained dispatch's gate (the carried P_ERR makes it an identity
+# no-op); the chaos hook fakes the error host-side, so the speculative
+# era may have run real work — the reload discards it wholesale either
+# way, and the resumed run must still land on the exact golden.
+
+
+def test_tpu_bfs_chaos_spec_discard_recovers(tmp_path):
+    ckpt = str(tmp_path / "spec.ckpt.npz")
+    # Seed a checkpoint generation (state-count targets run serial).
+    part = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .target_state_count(2_000)
+        .spawn_tpu_bfs(checkpoint_path=ckpt, **OPTS)
+        .join()
+    )
+    assert 0 < part.unique_state_count() < 8832
+    # Resume pipelined: a long cadence keeps the chain gate open, so the
+    # chaos-faked error lands while a speculative era is in flight.
+    checker = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_tpu_bfs(
+            resume_from=ckpt,
+            checkpoint_path=ckpt,
+            checkpoint_every=30.0,
+            **OPTS,
+        )
+    )
+    checker._chaos_probe_error_era = 1
+    checker.join()
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+    tel = checker.telemetry()
+    assert tel.get("degraded_regrow", 0) == 1
+    assert tel.get("spec_dispatch", 0) >= 1
+    assert tel.get("spec_wasted", 0) >= 1
+
+
+def test_mesh_chaos_spec_discard_recovers(tmp_path, devices):
+    ckpt = str(tmp_path / "mesh-spec.ckpt.npz")
+    opts = dict(
+        devices=devices,
+        chunk_size=64,
+        queue_capacity_per_shard=1 << 11,
+        table_capacity_per_shard=1 << 10,
+        sync_steps=4,
+    )
+    part = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .target_state_count(3_000)
+        .spawn_sharded_bfs(checkpoint_path=ckpt, **opts)
+        .join()
+    )
+    assert 0 < part.unique_state_count() < 8832
+    checker = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_sharded_bfs(
+            resume_from=ckpt,
+            checkpoint_path=ckpt,
+            checkpoint_every=30.0,
+            **opts,
+        )
+    )
+    checker._chaos_probe_error_era = 1
+    checker.join()
+    assert checker.unique_state_count() == 8832
+    tel = checker.telemetry()
+    assert tel.get("degraded_regrow", 0) == 1
+    assert tel.get("spec_dispatch", 0) >= 1
+    assert tel.get("spec_wasted", 0) >= 1
