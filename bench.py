@@ -403,13 +403,14 @@ GATE_BASELINE_WINDOW = 5
 # Direction inference by metric-name fragment. Higher-better: throughput
 # rates and speedups. Lower-better: wall times, latency quantiles,
 # instrumentation overheads, the flight recorder's host-gap share
-# (dispatch-bound idle time the pipelining work exists to remove), and
-# era counts (fewer eras = bigger mega-eras = fewer host round-trips).
-# Keys matching neither stay out of the gate.
+# (dispatch-bound idle time the pipelining work exists to remove), era
+# counts (fewer eras = bigger mega-eras = fewer host round-trips), and
+# memory residency per unique state (ledger peak / unique — footprint
+# regressions surface here). Keys matching neither stay out of the gate.
 _GATE_HIGHER = ("states_per_sec", "checks_per_sec", "per_sec", "speedup")
 _GATE_LOWER = (
     "p50", "p95", "p99", "secs", "ms", "overhead_pct",
-    "host_gap_pct", "eras",
+    "host_gap_pct", "eras", "bytes_per_state",
 )
 
 # Sections whose numeric leaves are environment/diagnostic detail, not
@@ -886,6 +887,57 @@ def main() -> int:
     }
     assert flight_overhead_pct < 2.0, detail["tpc7_flight_cost"]
     assert recon_err_pct < 5.0, detail["tpc7_flight_cost"]
+
+    # Memory: the headline run's ledger peak (obs/memory.py), residency
+    # per unique state (gate-tracked, lower-better), and the capacity
+    # planner's static prediction at the same geometry vs the measured
+    # peak (acceptance: within 15%). The control is the same workload
+    # with .memory(False) (acceptance: ledger + forecaster cost < 1% —
+    # the accounting is analytic host arithmetic riding the existing
+    # per-era readback). The 1% budget is asserted on each side's BEST
+    # of 3 times: a real fixed cost survives at the noise floor, while
+    # per-run scheduler jitter (several % on shared CPU hosts) does not.
+    from stateright_tpu.obs.memory import plan as memory_plan
+
+    mem_snap = dev7.telemetry().get("memory") or {}
+    measured_peak = int(mem_snap.get("peak_bytes", 0))
+    assert measured_peak > 0, "headline run recorded no memory ledger"
+    p7 = memory_plan(
+        TensorModelAdapter(tm7),
+        engine="tpu_bfs",
+        chunk=opts["chunk_size"],
+        queue_capacity=opts["queue_capacity"],
+        table_capacity=opts["table_capacity"],
+    )
+    predicted = int(p7["total_bytes"])
+    plan_err_pct = abs(predicted - measured_peak) / measured_peak * 100.0
+    TensorModelAdapter(tm7).checker().memory(False).spawn_tpu_bfs(
+        **opts
+    ).join()  # compile
+    med7mm, spread7mm, dev7mm = timed3(
+        lambda: (
+            TensorModelAdapter(tm7).checker().memory(False)
+            .spawn_tpu_bfs(**opts)
+        ),
+        golden=tpc7_golden,
+    )
+    rate_mm_off = dev7mm.state_count() / med7mm
+    rate_on_best = dev7.state_count() / spread7[0]
+    rate_off_best = dev7mm.state_count() / spread7mm[0]
+    mem_overhead_pct = (1.0 - rate_on_best / rate_off_best) * 100.0
+    detail["tpc7_memory"] = {
+        "peak_bytes": measured_peak,
+        "memory_peak_bytes_per_state": round(
+            measured_peak / dev7.unique_state_count(), 2
+        ),
+        "predicted_bytes": predicted,
+        "plan_err_pct": round(plan_err_pct, 2),
+        "states_per_sec_memory_on": round(dev_rate, 1),
+        "states_per_sec_memory_off": round(rate_mm_off, 1),
+        "overhead_pct": round(mem_overhead_pct, 2),
+    }
+    assert plan_err_pct <= 15.0, detail["tpc7_memory"]
+    assert mem_overhead_pct < 1.0, detail["tpc7_memory"]
 
     # Stage profile: ONE extra run with `.stage_profile()` — kept out of
     # the timed3 window above so the isolated-stage microbenches (a few

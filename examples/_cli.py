@@ -2,11 +2,13 @@
 
 Role parity with the per-example pico-args CLIs in the reference
 (e.g. examples/paxos.rs:354-510): each example exposes `check` /
-`check-dfs` / `check-simulation` / `lint` / `explore` / `spawn`
-subcommands with positional arguments for problem size and network
-semantics. `lint` runs the speclint static analysis
+`check-dfs` / `check-simulation` / `lint` / `explore` / `plan` /
+`spawn` subcommands with positional arguments for problem size and
+network semantics. `lint` runs the speclint static analysis
 (stateright_tpu.analysis) instead of a checking run; its exit status is
-nonzero when error-severity diagnostics are found.
+nonzero when error-severity diagnostics are found. `plan` predicts a
+bundled spec's device footprint (stateright_tpu.obs.memory) without
+dispatching anything.
 """
 
 from __future__ import annotations
@@ -143,6 +145,17 @@ def example_main(
         address = arg(0, "localhost:3001")
         print(f"Run service (submit specs like 2pc:3) on {address}.")
         serve_run_service(address)
+    elif subcommand == "plan":
+        # Capacity planning (stateright_tpu.obs.memory): predict the
+        # device footprint of a bundled spec ("2pc:5") at an engine's
+        # geometry BEFORE any dispatch. Same registry as `serve`
+        # submissions and `python -m stateright_tpu.obs.memory`.
+        from stateright_tpu.obs.memory import main as plan_main
+
+        if not rest:
+            print(f"Usage: {sys.argv[0]} plan SPEC [--engine E] [--json] ...")
+            raise SystemExit(2)
+        raise SystemExit(plan_main(rest))
     elif subcommand == "conform":
         if conform_info is None:
             print(f"{name} does not support the conform subcommand.")
@@ -168,6 +181,7 @@ def example_main(
     else:
         print(
             f"Usage: {sys.argv[0]} "
-            "[check|check-dfs|check-simulation|lint|explore|serve|spawn|conform]"
+            "[check|check-dfs|check-simulation|lint|explore|serve|plan|"
+            "spawn|conform]"
         )
         raise SystemExit(1)

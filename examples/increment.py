@@ -309,6 +309,13 @@ def main(argv=None):
     ckpt = _pop_flag(argv, "--checkpoint")
     ckpt_every = _pop_flag(argv, "--checkpoint-every")
     resume = _pop_flag(argv, "--resume")
+    if subcommand == "plan":
+        # Capacity planning (stateright_tpu.obs.memory): predict the
+        # device footprint of a spec BEFORE any dispatch.
+        from stateright_tpu.obs.memory import main as plan_main
+
+        raise SystemExit(plan_main(argv[1:] or ["increment:2"]))
+
     thread_count = 2
     if subcommand not in ("spawn-record", "conform") and len(argv) > 1:
         thread_count = int(argv[1])
@@ -393,6 +400,10 @@ def main(argv=None):
         )
         print("  python examples/increment.py spawn-record [TRACE] [SECONDS] [SEED]")
         print("  python examples/increment.py conform TRACE [CLIENT_COUNT]")
+        print(
+            "  python examples/increment.py plan [SPEC]"
+            " [--engine E] [--limit-bytes N] [--json]"
+        )
 
 
 if __name__ == "__main__":

@@ -37,6 +37,15 @@ def main(argv=None):
     ckpt_every = _pop_flag(argv, "--checkpoint-every")
     resume = _pop_flag(argv, "--resume")
 
+    if subcommand == "plan":
+        # Capacity planning (stateright_tpu.obs.memory): predict the
+        # device footprint of a spec at an engine's geometry BEFORE any
+        # dispatch. Defaults to this example's own model.
+        from stateright_tpu.obs.memory import main as plan_main
+
+        rest = argv[1:] or ["2pc:3"]
+        raise SystemExit(plan_main(rest))
+
     def arg(i, default):
         return argv[1 + i] if len(argv) > 1 + i else default
 
@@ -103,6 +112,10 @@ def main(argv=None):
         )
         print("  python examples/two_phase_commit.py lint [RM_COUNT]")
         print("  python examples/two_phase_commit.py explore [RM_COUNT] [ADDRESS]")
+        print(
+            "  python examples/two_phase_commit.py plan [SPEC]"
+            " [--engine E] [--limit-bytes N] [--json]"
+        )
 
 
 if __name__ == "__main__":

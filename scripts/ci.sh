@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Ten stages, fail-fast:
+# Eleven stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -33,7 +33,12 @@
 #   9. a pipelining smoke: a tiny run with speculative era dispatch
 #      forced ON (many short eras) must golden-match the serial driver
 #      bit-for-bit and report a flight summary with `host_gap_pct`,
-#  10. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#  10. a memory smoke: the capacity planner predicts a small run's
+#      footprint before dispatch, the run's memory ledger must match
+#      the live buffers' nbytes EXACTLY and the planner's prediction,
+#      and the `memory_bytes{component=...}` series must render in the
+#      Prometheus exposition,
+#  11. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -346,6 +351,44 @@ print(
     f"{tel['spec_dispatch']} speculative dispatches "
     f"({tel.get('spec_wasted', 0)} wasted), "
     f"host_gap_pct={fsum['host_gap_pct']}"
+)
+PY
+
+echo "== memory smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+from stateright_tpu.obs.memory import plan
+from stateright_tpu.obs.metrics import MEMORY_SERIES_LABELS, render_prometheus
+
+# Plan BEFORE dispatch at a fixed no-growth geometry...
+geometry = dict(chunk=256, queue_capacity=1 << 12, table_capacity=1 << 15)
+model = TensorModelAdapter(TwoPhaseTensor(3))
+p = plan(model, engine="tpu_bfs", **geometry)
+assert p["total_bytes"] > 0, p
+
+# ...then run at the same geometry: the ledger must equal BOTH the live
+# buffers' nbytes and the planner's prediction, exactly.
+c = (
+    model.checker()
+    .spawn_tpu_bfs(
+        chunk_size=geometry["chunk"],
+        queue_capacity=geometry["queue_capacity"],
+        table_capacity=geometry["table_capacity"],
+    )
+    .join()
+)
+assert c.unique_state_count() == 288, c.unique_state_count()
+snap = c.telemetry()["memory"]
+assert snap["total_bytes"] == c._memory.ledger.live_nbytes(), snap
+assert snap["total_bytes"] == p["total_bytes"], (snap["total_bytes"], p)
+
+# The per-component residency must land in the Prometheus exposition.
+prom = render_prometheus(c.telemetry(), labels=MEMORY_SERIES_LABELS)
+assert 'memory_bytes{component="visited_table"}' in prom, prom[:400]
+print(
+    f"memory smoke OK: plan == ledger == nbytes == {p['total_bytes']} B "
+    f"across {len(snap['components'])} components"
 )
 PY
 

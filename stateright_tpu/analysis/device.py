@@ -17,6 +17,9 @@ Codes:
   STR206  within_boundary_lanes output is not a bool[B]
   STR207  step_lanes output dtype drifts off uint32 (promotion), or lane
           values overflow the uint32 fingerprint packing
+  STR208  default-geometry device footprint exceeds this host's device
+          memory (obs/memory.py capacity planner) — the run would OOM
+          mid-era; the finding names a fitting alternative engine
 """
 
 from __future__ import annotations
@@ -54,6 +57,7 @@ def run(tm: TensorModel, rows: np.ndarray, report: AnalysisReport) -> None:
 
     if not _check_init_array(tm, report, S):
         return
+    _check_footprint(tm, report)
     if rows.size == 0:
         return
     lanes = tuple(np.ascontiguousarray(rows[:, i]) for i in range(S))
@@ -121,6 +125,40 @@ def _check_init_array(tm: TensorModel, report: AnalysisReport, S: int) -> bool:
         )
         return False
     return True
+
+
+def _check_footprint(tm: TensorModel, report: AnalysisReport) -> None:
+    """STR208: the default-geometry solo-engine footprint (obs/memory's
+    capacity planner) exceeds this host's device memory — the run would
+    OOM mid-era instead of failing here, attributably. Warning severity
+    because geometry is overridable at spawn time; skipped entirely when
+    no device limit is discoverable (CPU test hosts)."""
+    from ..obs.memory import device_memory_bytes, plan, recommend_engine
+
+    limit = device_memory_bytes()
+    if limit is None:
+        return
+    try:
+        p = plan(tm, engine="tpu_bfs", device_limit_bytes=limit)
+    except Exception:
+        return  # planning is advisory; never fail the lint on its bugs
+    if p["fits"]:
+        return
+    alt = recommend_engine(tm, limit, exclude=("tpu_bfs",))
+    rec = (
+        f"spawn with the {alt!r} engine, or shrink table/queue capacity"
+        if alt is not None
+        else "shrink table/queue capacity or shard across more devices"
+    )
+    report.add(
+        "STR208",
+        Severity.WARNING,
+        f"default-geometry tpu_bfs footprint is {p['total_bytes']} bytes, "
+        f"over this host's device memory ({limit} bytes); the run would "
+        "OOM mid-era",
+        _loc(tm, "state_width"),
+        rec,
+    )
 
 
 def _check_numpy_step(tm, lanes, report: AnalysisReport, S: int, A: int):

@@ -64,6 +64,7 @@ class CheckerBuilder:
         self.flight_capacity_: int = 4096
         self.flight_path_: Optional[str] = None
         self.flight_format_: str = "jsonl"
+        self.memory_: bool = True
         self.pipeline_: bool = True
 
     # -- options ------------------------------------------------------------
@@ -169,6 +170,20 @@ class CheckerBuilder:
         self.flight_capacity_ = max(1, int(capacity))
         self.flight_path_ = path
         self.flight_format_ = format
+        return self
+
+    def memory(self, enable: bool = True) -> "CheckerBuilder":
+        """Toggle the device-memory ledger (obs/memory.py): exact
+        per-component accounting of every device allocation (visited
+        table, frontier queue, packed params, coverage slab, spill
+        staging) plus the per-era growth forecaster that projects
+        eras-to-grow / eras-to-exhaustion and fires a one-shot pressure
+        warning. On by default; the records ride the flight recorder's
+        existing once-per-era readback (zero extra device round-trips,
+        <1% overhead asserted by bench.py). Surfaced via
+        ``telemetry()["memory"]``, ``memory_bytes{component=...}``
+        Prometheus gauges, and the Explorer's ``GET /memory``."""
+        self.memory_ = enable
         return self
 
     def multiplex_lane(self, enable: bool = True) -> "CheckerBuilder":

@@ -19,6 +19,7 @@ from ..core import Expectation
 from ..obs.coverage import Coverage
 from ..obs.flight import FlightRecorder
 from ..obs.log import get_logger
+from ..obs.memory import MemoryRecorder
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import make_trace_writer, start_profile, stop_profile
 
@@ -109,6 +110,16 @@ class HostEngineBase(Checker):
         self._flight_path: Optional[str] = getattr(builder, "flight_path_", None)
         self._flight_format: str = getattr(builder, "flight_format_", "jsonl")
         self._flight_prev_counters: Dict[str, int] = {}
+        # Memory recorder (obs/memory.py): exact per-component ledger of
+        # device allocations + growth forecaster. Device engines register
+        # their buffers after seeding and feed it at the same per-era
+        # readback as the flight recorder; host engines carry the (empty)
+        # recorder so telemetry()["memory"] stays uniform.
+        self._memory = (
+            MemoryRecorder(engine=type(self).__name__, metrics=self._metrics)
+            if getattr(builder, "memory_", True)
+            else None
+        )
         # Span ledger (obs/spans.py) via CheckerBuilder.spans(): the whole
         # run becomes one "run" span with phase-timer children; the run
         # span's id is pre-assigned so per-era progress spans can parent to
@@ -323,6 +334,8 @@ class HostEngineBase(Checker):
             fsum = self._flight.summary()
             if fsum["eras"]:
                 snap["flight"] = fsum
+        if self._memory is not None and self._memory.ledger.components():
+            snap["memory"] = self._memory.snapshot()
         snap["engine"] = type(self).__name__
         return snap
 
@@ -347,11 +360,20 @@ class HostEngineBase(Checker):
         take_cap: int = 0,
         spill_rows: int = 0,
         shards: Optional[Dict[str, Any]] = None,
+        grow_rows: Optional[int] = None,
     ) -> None:
         """Append one era to the flight recording (no-op when disabled).
         Registry counters that move off the hot path (refill/grow/
         checkpoint) are diffed against the previous era here, so engines
-        don't have to thread per-era volumes through their loops."""
+        don't have to thread per-era volumes through their loops.
+        ``grow_rows`` is what the engine's table-grow trigger compares
+        (max per-shard unique on the mesh); the memory forecaster fits
+        its growth curve to it, defaulting to ``unique``."""
+        mem = None
+        if self._memory is not None:
+            mem = self._memory.on_era(
+                unique=unique, load_factor=load_factor, grow_rows=grow_rows
+            )
         fr = self._flight
         if fr is None:
             return
@@ -376,6 +398,7 @@ class HostEngineBase(Checker):
                 cur["checkpoint_saves"] - prev.get("checkpoint_saves", 0)
             ),
             shards=shards,
+            memory=mem,
         )
         # Flat twins of the latest record for Prometheus (nested dicts are
         # skipped by render_prometheus) and the SSE metrics deltas.

@@ -1236,6 +1236,7 @@ class TpuBfsChecker(HostEngineBase):
             count = n_init
             # Provisional (exact unless dup inits); corrected at first read.
             self._unique = n_init
+            self._mem_register(table, queue, (rec_fp1, rec_fp2), params_dev)
             last_max_steps = max_steps0
             first_result_pending = True
             _dbg("run: seeded; entering era loop")
@@ -1386,6 +1387,12 @@ class TpuBfsChecker(HostEngineBase):
                 # slight over-report beats a systematic under-report.)
                 self._max_depth = max(self._max_depth, int(big[:, S + 1].max()))
                 params_dev = None  # host-side count changed; force re-upload
+                if self._memory is not None:
+                    self._memory.staging(
+                        sum(b.nbytes for b in self._spill),
+                        event="spill",
+                        rows=int(k),
+                    )
 
             self._obs_event(
                 "era",
@@ -1490,6 +1497,12 @@ class TpuBfsChecker(HostEngineBase):
                 count += k
                 self._metrics.inc("refill_rows", k)
                 host_dirty = True
+                if self._memory is not None:
+                    self._memory.staging(
+                        sum(b.nbytes for b in self._spill),
+                        event="refill",
+                        rows=int(k),
+                    )
             if count == 0:
                 break
 
@@ -1497,11 +1510,15 @@ class TpuBfsChecker(HostEngineBase):
             # the load factor under vs.MAX_LOAD, so probe budgets can't be
             # exhausted (exhaustion would silently drop states).
             vcap = _vcap(A, C)
+            grew = False
             while self._unique + vcap > vs.MAX_LOAD * self._tcap:
                 with self._metrics.phase("table_grow"):
                     table, self._tcap = self._grow_table(table)
                 self._metrics.inc("table_growths")
                 host_dirty = True
+                grew = True
+            if grew:
+                self._mem_register(table, queue, (rec_fp1, rec_fp2), params_dev)
             grow_limit = max(0, int(vs.MAX_LOAD * self._tcap) - vcap)
 
             # The era budget is the device-emitted one (== max_sync
@@ -1658,6 +1675,13 @@ class TpuBfsChecker(HostEngineBase):
                     "degraded_regrow", frontier=count, new_tcap=self._tcap
                 )
                 params_dev = None  # host state changed; force re-upload
+                if self._memory is not None:
+                    self._memory.event(
+                        "checkpoint_load", frontier=int(count)
+                    )
+                    self._mem_register(
+                        table, queue, (rec_fp1, rec_fp2), params_dev
+                    )
 
         # A final checkpoint makes interrupted runs (targets, timeouts)
         # resumable from their exact stopping point.
@@ -1673,7 +1697,57 @@ class TpuBfsChecker(HostEngineBase):
 
         # Retained (on device) for path reconstruction; downloaded lazily.
         self._table_dev = table
+        if self._memory is not None:
+            # Re-point the ledger at the final era's live buffers (shapes
+            # are identical across an era; this keeps the nbytes parity
+            # check honest against what is actually resident at run end).
+            led = self._memory.ledger
+            led.attach("visited_table", table)
+            led.attach("frontier_queue", queue)
+            led.attach("record_fps", (rec_fp1, rec_fp2))
+            if params_dev is not None:
+                led.attach("packed_params", params_dev)
+                led.attach("coverage_slab", params_dev)
         return
+
+    def _mem_register(self, table, queue, rec_fps, params_dev) -> None:
+        """(Re-)register every device buffer with the memory ledger from
+        the shared size formulas (obs/memory.py bfs_component_sizes) —
+        the planner predicts exactly what lands here, and the parity test
+        locks the formulas to the live nbytes. Called after seeding and
+        after every table growth; re-registration at a new size logs the
+        growth event. A ``None`` params_dev keeps the previous reference
+        (sizes are unchanged; .nbytes is aval metadata either way)."""
+        rec = self._memory
+        if rec is None:
+            return
+        from ..obs.memory import bfs_component_sizes
+        from ..ops import visited_set as vs
+
+        sizes = bfs_component_sizes(
+            self.tm.state_width,
+            self.tm.max_actions,
+            len(self._tprops),
+            chunk=self._chunk,
+            queue_capacity=self._qcap,
+            table_capacity=self._tcap,
+            coverage=self._cov,
+        )
+        rec.register_components(
+            sizes,
+            arrays={
+                "visited_table": table,
+                "frontier_queue": queue,
+                "record_fps": rec_fps,
+                "packed_params": params_dev,
+                "coverage_slab": params_dev,
+            },
+        )
+        rec.set_geometry(
+            rows=self._tcap,
+            max_load=vs.MAX_LOAD,
+            reserve_rows=_vcap(self.tm.max_actions, self._chunk),
+        )
 
     def _small_workload_hint(self, n: int, kind: str) -> None:
         """One-line telemetry warning: below the crossover the host engine

@@ -724,6 +724,28 @@ class TpuSimulationChecker(HostEngineBase):
             if first:
                 walk, fp1buf, fp2buf, params_dev = self._seed_run(params_dev)
                 first = False
+                if self._memory is not None:
+                    # Static footprint (no growth/spill): register once
+                    # from the shared size formulas so the planner and the
+                    # nbytes parity test agree with the live allocation.
+                    from ..obs.memory import sim_component_sizes
+
+                    self._memory.register_components(
+                        sim_component_sizes(
+                            S,
+                            A,
+                            P,
+                            walks=B,
+                            walk_cap=L,
+                            coverage=self._cov,
+                        ),
+                        arrays={
+                            "walk_lanes": walk,
+                            "path_fps": (fp1buf, fp2buf),
+                            "packed_params": params_dev,
+                            "coverage_slab": params_dev,
+                        },
+                    )
             else:
                 walk, fp1buf, fp2buf, params_dev = self._loop(
                     walk, fp1buf, fp2buf, params_dev

@@ -22,6 +22,11 @@ Reference parity: src/checker/explorer.rs. Routes:
     host_gap wall split, frontier occupancy, load factor, spill/refill
     volumes) plus the run-level summary — feeding the dashboard's
     flight timeline panel;
+  - ``GET /memory`` (alias ``/.memory``) — the run's memory-ledger
+    snapshot (obs/memory.py): per-component device residency with
+    shapes/dtypes, growth events, live headroom, the forecaster's
+    eras-to-exhaustion projection, and the early warning once one has
+    fired — feeding the dashboard's memory panel;
   - ``GET /events`` — Server-Sent Events stream (text/event-stream):
     ``span`` events as the checker's spans complete (obs/spans.py) and
     periodic ``metrics`` events carrying the numeric telemetry deltas
@@ -272,14 +277,20 @@ def _metrics_view(checker: Checker) -> Dict:
 def _metrics_prometheus(checker: Checker) -> str:
     """GET /metrics?format=prometheus: the same snapshot in Prometheus
     text exposition format (obs/metrics.py:render_prometheus)."""
-    from ..obs.metrics import SHARD_SERIES_LABELS, render_prometheus
+    from ..obs.metrics import (
+        MEMORY_SERIES_LABELS,
+        SHARD_SERIES_LABELS,
+        render_prometheus,
+    )
 
     snap = dict(checker.telemetry())
     snap.setdefault("state_count", checker.state_count())
     snap.setdefault("unique_state_count", checker.unique_state_count())
     snap.setdefault("max_depth", checker.max_depth())
     snap.setdefault("done", checker.is_done())
-    return render_prometheus(snap, labels=SHARD_SERIES_LABELS)
+    return render_prometheus(
+        snap, labels={**SHARD_SERIES_LABELS, **MEMORY_SERIES_LABELS}
+    )
 
 
 def _coverage_view(checker: Checker) -> Dict:
@@ -302,6 +313,20 @@ def _flight_view(checker: Checker) -> Dict:
         "done": checker.is_done(),
         "records": checker.flight(),
         "summary": summary,
+    }
+
+
+def _memory_view(checker: Checker) -> Dict:
+    """GET /memory: the run's memory-ledger snapshot (obs/memory.py) —
+    per-component residency, growth events, the forecaster's projection,
+    and the early warning when one fired — timestamped like /metrics so
+    the dashboard can poll it. Engines without a ledger (host engines,
+    `.memory(False)` runs) serve an empty ``memory`` object."""
+    memory = (checker.telemetry() or {}).get("memory") or {}
+    return {
+        "ts": time.time(),
+        "done": checker.is_done(),
+        "memory": memory,
     }
 
 
@@ -492,6 +517,8 @@ class ExplorerServer:
                     self._send_json(_coverage_view(explorer.checker))
                 elif path in ("/flight", "/.flight"):
                     self._send_json(_flight_view(explorer.checker))
+                elif path in ("/memory", "/.memory"):
+                    self._send_json(_memory_view(explorer.checker))
                 elif path in ("/events", "/.events"):
                     self._serve_sse(
                         explorer.spans,

@@ -364,6 +364,89 @@ async function pollFlight() {
   setTimeout(pollFlight, 2000);
 }
 
+// ---- memory panel ----------------------------------------------------------
+// Polls /memory every 2s: the ledger's per-component device residency as
+// horizontal bars (obs/memory.py), a headroom/forecast readout, and the
+// forecaster's one-shot early warning as a banner once it has fired.
+
+function fmtBytes(n) {
+  if (n == null) return "–";
+  const units = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let v = n;
+  let u = 0;
+  while (v >= 1024 && u < units.length - 1) {
+    v /= 1024;
+    u += 1;
+  }
+  return (u === 0 || v >= 10 ? Math.round(v) : v.toFixed(1)) + " " + units[u];
+}
+
+function renderMemoryBars(components) {
+  const holder = $("memory-bars");
+  holder.innerHTML = "";
+  const entries = Object.entries(components).sort(
+    (a, b) => b[1].bytes - a[1].bytes
+  );
+  const max = Math.max(...entries.map(([, c]) => c.bytes), 1);
+  for (const [label, c] of entries) {
+    const row = document.createElement("div");
+    row.className = "cov-row";
+    const name = document.createElement("span");
+    name.className = "cov-label";
+    name.textContent = label;
+    name.title = `shape ${JSON.stringify(c.shape)} · ${c.dtype}`;
+    const track = document.createElement("span");
+    track.className = "cov-track";
+    const bar = document.createElement("span");
+    bar.className = "cov-bar mem-bar";
+    bar.style.width = Math.max(1, (c.bytes / max) * 100).toFixed(1) + "%";
+    track.appendChild(bar);
+    const val = document.createElement("span");
+    val.className = "cov-count";
+    val.textContent = fmtBytes(c.bytes);
+    row.appendChild(name);
+    row.appendChild(track);
+    row.appendChild(val);
+    holder.appendChild(row);
+  }
+}
+
+async function pollMemory() {
+  try {
+    const res = await fetch("/memory");
+    const body = await res.json();
+    const mem = body.memory || {};
+    const components = mem.components || {};
+    if (Object.keys(components).length) {
+      $("memory-panel").hidden = false;
+      renderMemoryBars(components);
+      const bits = [
+        `total ${fmtBytes(mem.total_bytes)}`,
+        `peak ${fmtBytes(mem.peak_bytes)}`,
+      ];
+      if (mem.host_bytes) bits.push(`host staging ${fmtBytes(mem.host_bytes)}`);
+      if (mem.headroom_bytes != null)
+        bits.push(`headroom ${fmtBytes(mem.headroom_bytes)}`);
+      const fc = mem.forecast || {};
+      if (fc.eras_to_exhaustion != null)
+        bits.push(`~${fc.eras_to_exhaustion} eras to exhaustion`);
+      else if (fc.eras_to_grow != null)
+        bits.push(`~${fc.eras_to_grow} eras to next growth`);
+      $("memory-readout").textContent = bits.join(" · ");
+      const warnEl = $("memory-warning");
+      if (mem.warning) {
+        warnEl.hidden = false;
+        warnEl.textContent = "⚠ " + mem.warning;
+      } else {
+        warnEl.hidden = true;
+      }
+    }
+  } catch (e) {
+    /* memory endpoint unavailable: leave the panel hidden */
+  }
+  setTimeout(pollMemory, 2000);
+}
+
 // ---- span waterfall (run ledger) -------------------------------------------
 // Span completions arrive live over GET /events (SSE, obs/spans.py). The
 // waterfall draws the most recent trace's spans as horizontal bars on a
@@ -572,5 +655,6 @@ pollStatus();
 pollMetrics();
 pollCoverage();
 pollFlight();
+pollMemory();
 startSpanStream();
 loadStates();

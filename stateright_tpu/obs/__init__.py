@@ -26,7 +26,17 @@ catalog, `obs/coverage.py` for coverage-count semantics, and
 from .coverage import DEPTH_CAP, Coverage
 from .flight import DEFAULT_FLIGHT_CAPACITY, FlightRecorder
 from .log import get_logger
+from .memory import (
+    Forecaster,
+    MemoryLedger,
+    MemoryRecorder,
+    device_memory_bytes,
+    format_plan,
+    plan,
+    recommend_engine,
+)
 from .metrics import (
+    MEMORY_SERIES_LABELS,
     SHARD_SERIES_LABELS,
     Histogram,
     MetricsRegistry,
@@ -48,17 +58,25 @@ __all__ = [
     "ChromeTraceWriter",
     "Coverage",
     "FlightRecorder",
+    "Forecaster",
     "Histogram",
+    "MEMORY_SERIES_LABELS",
+    "MemoryLedger",
+    "MemoryRecorder",
     "MetricsRegistry",
     "SHARD_SERIES_LABELS",
     "STAGE_ORDER",
     "SpanRecorder",
     "TraceWriter",
     "attach_phase_spans",
+    "device_memory_bytes",
+    "format_plan",
     "get_logger",
     "make_trace_writer",
     "new_span_id",
     "new_trace_id",
+    "plan",
+    "recommend_engine",
     "render_prometheus",
     "stage_rows",
     "start_profile",

@@ -45,7 +45,10 @@ One record per era::
 
 The sharded engine additionally attaches a ``shards`` dict mapping
 shard index -> ``{"frontier", "load_factor", "exchange_rows"}`` so
-cross-shard imbalance is visible record by record.
+cross-shard imbalance is visible record by record. With the memory
+ledger on (obs/memory.py, the default), each record also carries a
+``memory`` dict — bytes by component, headroom, and the forecaster's
+grow/exhaustion horizons — derived from the same readback.
 
 Surfaces: ``Checker.flight()`` returns the records,
 ``telemetry()["flight"]`` carries the summary (which also rides the SSE
@@ -117,6 +120,7 @@ class FlightRecorder:
         table_growths=0,
         checkpoint_saves=0,
         shards=None,
+        memory=None,
         t=None,
     ):
         """Append one era record; returns the record dict."""
@@ -158,6 +162,8 @@ class FlightRecorder:
             }
             if shards:
                 rec["shards"] = shards
+            if memory:
+                rec["memory"] = memory
             if len(self._ring) == self._ring.maxlen:
                 self._dropped += 1
             self._ring.append(rec)

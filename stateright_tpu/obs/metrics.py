@@ -297,6 +297,14 @@ SHARD_SERIES_LABELS = {
     "shard_load_factor": "shard",
 }
 
+#: Dict-valued memory-ledger gauge (obs/memory.py MemoryRecorder) ->
+#: Prometheus label key, so per-component residency renders as
+#: ``stateright_memory_bytes{component="visited_table"} 67108864``.
+#: Merge alongside SHARD_SERIES_LABELS wherever snapshots are rendered.
+MEMORY_SERIES_LABELS = {
+    "memory_bytes": "component",
+}
+
 
 def render_prometheus(
     snapshot: Dict[str, Any],

@@ -1028,6 +1028,51 @@ class ShardedBfsChecker(HostEngineBase):
             high_water, W,
         )
 
+    def _mem_register(self, table, queue, rec_fps, params_dev) -> None:
+        """(Re-)register the mesh's device buffers with the memory ledger
+        from the shared size formulas (obs/memory.py
+        mesh_component_sizes); every component carries the shard
+        dimension. Called at loop entry and after every uniform all-shard
+        table growth; a re-registration at a new size logs the growth
+        event. The packed params row block is attached at each dispatch
+        (it is rebuilt per era)."""
+        rec = self._memory
+        if rec is None:
+            return
+        from ..obs.memory import mesh_component_sizes
+        from ..ops import visited_set as vs
+
+        sizes = mesh_component_sizes(
+            self.tm.state_width,
+            self.tm.max_actions,
+            len(self._tprops),
+            chunk=self._chunk,
+            queue_capacity_per_shard=self._qcap,
+            table_capacity_per_shard=self._tcap,
+            n_shards=self.n_shards,
+            coverage=self._cov,
+        )
+        rec.register_components(
+            sizes,
+            arrays={
+                "visited_table": table,
+                "frontier_queue": queue,
+                "record_fps": rec_fps,
+                "packed_params": params_dev,
+                "coverage_slab": params_dev,
+            },
+        )
+        rec.set_geometry(
+            rows=self._tcap,
+            max_load=vs.MAX_LOAD,
+            reserve_rows=self.n_shards * self._quota,
+        )
+
+    def _spill_host_bytes(self) -> int:
+        return sum(
+            b.nbytes for s in range(self.n_shards) for b in self._spill[s]
+        )
+
     def _run_loop(
         self, table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
         take_caps, disc_depth_best, per_shard_unique, depth_limit,
@@ -1036,6 +1081,8 @@ class ShardedBfsChecker(HostEngineBase):
         import time as _time
 
         import jax.numpy as jnp
+
+        self._mem_register(table, queue, (rec_fp1, rec_fp2), None)
 
         from ..ops import visited_set as vs
 
@@ -1159,6 +1206,11 @@ class ShardedBfsChecker(HostEngineBase):
                     frontier=int(counts.sum()),
                     new_tcap=self._tcap,
                 )
+                if self._memory is not None:
+                    self._memory.event(
+                        "checkpoint_load", frontier=int(counts.sum())
+                    )
+                    self._mem_register(table, queue, (rec_fp1, rec_fp2), None)
                 return False
             heads = vals[:, P_HEAD].astype(np.int64)
             counts = vals[:, P_COUNT].astype(np.int64)
@@ -1240,6 +1292,10 @@ class ShardedBfsChecker(HostEngineBase):
                     self._max_depth = max(
                         self._max_depth, int(big[:, S + 1].max())
                     )
+            if spilled and self._memory is not None:
+                self._memory.staging(
+                    self._spill_host_bytes(), event="spill", rows=int(spilled)
+                )
 
             # Per-shard telemetry off the same per-shard params rows (zero
             # extra device reads): labeled counter series (Prometheus
@@ -1338,6 +1394,7 @@ class ShardedBfsChecker(HostEngineBase):
                 take_cap=int(min(take_caps)),
                 spill_rows=spilled,
                 shards=shards_rec,
+                grow_rows=int(max(per_shard_unique)),
             )
 
             if self._finish_matched(self._discovery_fps):
@@ -1387,6 +1444,12 @@ class ShardedBfsChecker(HostEngineBase):
                         )
                     counts[s] += k
                     self._metrics.inc("refill_rows", k)
+                    if self._memory is not None:
+                        self._memory.staging(
+                            self._spill_host_bytes(),
+                            event="refill",
+                            rows=int(k),
+                        )
             if counts.sum() == 0:
                 if any(self._spill[s] for s in range(N)):
                     # Unreachable by the block-size invariant above; loud
@@ -1396,6 +1459,7 @@ class ShardedBfsChecker(HostEngineBase):
 
             # Grow ALL shard tables together when any shard nears the load
             # limit (uniform shapes keep one compiled program).
+            grew = False
             while (
                 max(per_shard_unique) + N * self._quota
                 > vs.MAX_LOAD * self._tcap
@@ -1403,6 +1467,9 @@ class ShardedBfsChecker(HostEngineBase):
                 with self._metrics.phase("table_grow"):
                     table = self._grow_tables(table)
                 self._metrics.inc("table_growths")
+                grew = True
+            if grew:
+                self._mem_register(table, queue, (rec_fp1, rec_fp2), None)
             grow_limit = max(
                 0, int(vs.MAX_LOAD * self._tcap) - N * self._quota
             )
@@ -1428,6 +1495,9 @@ class ShardedBfsChecker(HostEngineBase):
             table, queue, rec_fp1, rec_fp2, params, disc_depth = self._block(
                 table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
             )
+            if self._memory is not None:
+                self._memory.attach("packed_params", params)
+                self._memory.attach("coverage_slab", params)
             cur_budget = max_steps
             while True:
                 if not (
@@ -1526,6 +1596,12 @@ class ShardedBfsChecker(HostEngineBase):
             )
         self._profile_stages(table, queue)
         self._table_dev = table
+        if self._memory is not None:
+            # Final era's live buffers, for the post-run nbytes parity.
+            led = self._memory.ledger
+            led.attach("visited_table", table)
+            led.attach("frontier_queue", queue)
+            led.attach("record_fps", (rec_fp1, rec_fp2))
         return
 
     def _profile_stages(self, table, queue) -> None:

@@ -127,10 +127,15 @@ class WriteReporter(Reporter):
                     f"{data.total_states / data.duration_secs:.1f}\n"
                 )
             if data.telemetry:
+                telemetry = dict(data.telemetry)
+                # The memory snapshot is a nested document; it gets its
+                # own compact line instead of bloating the pairs line.
+                memory = telemetry.pop("memory", None)
                 pairs = ", ".join(
-                    f"{k}={v}" for k, v in sorted(data.telemetry.items())
+                    f"{k}={v}" for k, v in sorted(telemetry.items())
                 )
                 self.writer.write(f"Telemetry. {pairs}\n")
+                self._report_memory(memory)
             self._report_coverage(data.coverage)
         else:
             self.writer.write(
@@ -138,6 +143,30 @@ class WriteReporter(Reporter):
                 f"unique={data.unique_states}, depth={data.max_depth}"
                 f"{self._rate_suffix(data)}\n"
             )
+
+    def _report_memory(self, memory) -> None:
+        """One compact device-residency line from the memory ledger
+        (obs/memory.py), plus the forecaster's early warning when one
+        fired during the run. The full per-component snapshot stays in
+        ``telemetry()["memory"]``."""
+        if not memory or memory.get("total_bytes") is None:
+            return
+        parts = [
+            f"resident_bytes={memory['total_bytes']}",
+            f"peak_bytes={memory.get('peak_bytes', memory['total_bytes'])}",
+        ]
+        if memory.get("host_bytes"):
+            parts.append(f"host_bytes={memory['host_bytes']}")
+        if memory.get("headroom_bytes") is not None:
+            parts.append(f"headroom_bytes={memory['headroom_bytes']}")
+        forecast = memory.get("forecast") or {}
+        if forecast.get("eras_to_exhaustion") is not None:
+            parts.append(
+                f"eta_exhaustion_eras={forecast['eras_to_exhaustion']}"
+            )
+        self.writer.write(f"Memory. {', '.join(parts)}\n")
+        if memory.get("warning"):
+            self.writer.write(f"Warning. {memory['warning']}\n")
 
     def _report_coverage(self, coverage) -> None:
         """The final coverage summary + dead-action warning block.

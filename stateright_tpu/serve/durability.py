@@ -34,7 +34,7 @@ Journal record shapes (one JSON object per line)::
                                       "priority", "options"}}
   {"rec": "start",  "t": ..., "job_id": ..., "attempt": N}
   {"rec": "result", "t": ..., "job_id": ..., "status": "done"|"failed",
-                    "error": ...?}
+                    "error": ...?, "memory": ...?}
   {"rec": "cancel", "t": ..., "job_id": ...}
   {"rec": "retry",  "t": ..., "job_id": ...}
 
@@ -61,6 +61,7 @@ __all__ = [
     "ResultStore",
     "RetryPolicy",
     "classify_failure",
+    "is_oom",
 ]
 
 
@@ -101,6 +102,25 @@ def classify_failure(error: str) -> Tuple[bool, bool]:
     transient = any(m in low for m in _TRANSIENT_MARKERS)
     escalate = transient and any(m in low for m in _ESCALATE_MARKERS)
     return transient, escalate
+
+
+# Substrings that specifically mean the device ran out of memory (as
+# opposed to the other transient markers). An OOM failure carries a
+# post-mortem residency snapshot — the memory ledger at death, or the
+# planner's prediction when the engine died before reporting — into the
+# journal so `GET /jobs/{id}` can answer "what was resident when it died".
+_OOM_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "out-of-memory",
+)
+
+
+def is_oom(error: str) -> bool:
+    """Did this failure die on device memory?"""
+    low = error.lower()
+    return any(m in low for m in _OOM_MARKERS)
 
 
 # ---------------------------------------------------------------------------
@@ -261,10 +281,17 @@ class JobJournal:
         self._append({"rec": "start", "job_id": job_id, "attempt": attempt})
 
     def result(self, job_id: str, status: str,
-               error: Optional[str] = None) -> None:
-        rec = {"rec": "result", "job_id": job_id, "status": status}
+               error: Optional[str] = None,
+               memory: Optional[Dict[str, Any]] = None) -> None:
+        rec: Dict[str, Any] = {
+            "rec": "result", "job_id": job_id, "status": status,
+        }
         if error is not None:
             rec["error"] = error
+        if memory is not None:
+            # OOM post-mortem: the residency snapshot rides the terminal
+            # record so replay restores it alongside the error.
+            rec["memory"] = memory
         self._append(rec)
 
     def cancel(self, job_id: str) -> None:
@@ -313,6 +340,7 @@ class JobJournal:
                 elif kind == "result":
                     entry["status"] = rec.get("status", "done")
                     entry["error"] = rec.get("error")
+                    entry["memory"] = rec.get("memory")
                 elif kind == "cancel":
                     entry["status"] = "cancelled"
                 elif kind == "retry":
@@ -350,6 +378,8 @@ class JobJournal:
                                "status": status}
                         if entry.get("error"):
                             rec["error"] = entry["error"]
+                        if entry.get("memory"):
+                            rec["memory"] = entry["memory"]
                         out.write(json.dumps(rec, separators=(",", ":")) + "\n")
                     elif status == "cancelled":
                         out.write(json.dumps(

@@ -7,7 +7,10 @@ Routes (JSON in/out unless noted):
                                   "engine": "auto|multiplex|tpu_bfs|bfs",
                                   "target_max_depth": N}`` ->
                                   202 ``{"job_id", "status"}``; 400
-                                  malformed, 422 speclint STRxxx
+                                  malformed, 413 predicted memory
+                                  footprint exceeds the device budget
+                                  (predicted/available bytes in the
+                                  body), 422 speclint STRxxx
                                   diagnostics, 429 quota/rate limit
   ``GET /jobs``                   all job views (``?tenant=`` filters)
   ``GET /jobs/{id}``              one job's status view
@@ -48,7 +51,11 @@ from typing import Optional
 
 from ..explorer.server import JsonRequestHandler
 from ..obs.log import get_logger
-from ..obs.metrics import SHARD_SERIES_LABELS, render_prometheus
+from ..obs.metrics import (
+    MEMORY_SERIES_LABELS,
+    SHARD_SERIES_LABELS,
+    render_prometheus,
+)
 from .service import RunService
 
 __all__ = ["ServeServer", "serve"]
@@ -84,6 +91,7 @@ class ServeServer:
                         labels={
                             "serve_tenant_requests": "tenant",
                             **SHARD_SERIES_LABELS,
+                            **MEMORY_SERIES_LABELS,
                         },
                     )
                     self._send(

@@ -52,6 +52,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..engines.compiled import ExecutableCache, model_signature
+from ..obs import memory as obs_memory
 from ..obs.log import get_logger
 from ..obs.metrics import MetricsRegistry
 from ..obs.spans import (
@@ -67,6 +68,7 @@ from .durability import (
     ResultStore,
     RetryPolicy,
     classify_failure,
+    is_oom,
 )
 
 __all__ = ["Job", "RunService"]
@@ -92,6 +94,7 @@ class Job:
         "submitted_at", "started_at", "finished_at", "error", "result",
         "signature", "model", "options", "attempts",
         "trace_id", "root_span_id", "enqueued_at", "backoff_since",
+        "memory_at_failure",
     )
 
     def __init__(self, tenant: str, spec: str, engine: str, priority: int,
@@ -119,6 +122,10 @@ class Job:
         self.enqueued_at = self.submitted_at
         # When the job entered its current backoff window, if any.
         self.backoff_since: Optional[float] = None
+        # OOM post-mortem: device residency at the failure (the engine's
+        # memory-ledger snapshot, or the planner's prediction when the
+        # engine died before reporting one).
+        self.memory_at_failure: Optional[Dict[str, Any]] = None
 
     def journal_fields(self) -> Dict[str, Any]:
         """The job's identity as the write-ahead journal records it —
@@ -168,6 +175,8 @@ class Job:
         }
         if self.error is not None:
             out["error"] = self.error
+        if self.memory_at_failure is not None:
+            out["memory_at_failure"] = self.memory_at_failure
         return out
 
 
@@ -208,8 +217,17 @@ class RunService:
         retry: Optional[RetryPolicy] = None,
         breaker: Optional[CircuitBreaker] = None,
         guard_interval: float = 0.5,
+        device_memory_bytes: Optional[int] = None,
     ):
         self.lanes = lanes
+        # Device budget for the memory admission gate (413) and lane
+        # right-sizing; auto-detected when not given, and both features
+        # simply disable when no limit is known (CPU test runs).
+        self.device_memory_bytes = (
+            device_memory_bytes
+            if device_memory_bytes is not None
+            else obs_memory.device_memory_bytes()
+        )
         self.lane_options = {
             "lanes": lanes,
             "chunk": lane_chunk,
@@ -316,6 +334,7 @@ class RunService:
                     signature = model_signature(model)
             job = Job.restore(fields, model, signature)
             job.attempts = entry["attempts"]
+            job.memory_at_failure = entry.get("memory")
             self._jobs[job.id] = job
             self.metrics.inc("journal_replayed_jobs")
             if status == "done":
@@ -428,7 +447,8 @@ class RunService:
 
     def submit(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         """Admit one submission. Returns ``(http_status, body)``:
-        202 queued, 400 malformed, 422 speclint rejection, 429 quota."""
+        202 queued, 400 malformed, 413 predicted footprint exceeds
+        device memory, 422 speclint rejection, 429 quota."""
         admit_t0 = time.time()
         self.metrics.inc("serve_requests")
         spec = payload.get("spec") or payload.get("model")
@@ -471,6 +491,31 @@ class RunService:
                 f"({sum(report.counts_by_code().values())} findings)",
                 "diagnostics": report.to_dict(),
             }
+
+        # Memory admission gate: the capacity planner (obs/memory.plan)
+        # predicts the device footprint at THIS service's engine geometry
+        # before anything compiles; a submission that cannot fit is a 413
+        # with the arithmetic in the body, not a mid-run OOM.
+        if tensorish and self.device_memory_bytes is not None:
+            predicted = self._predicted_bytes(model, engine)
+            if predicted is not None and predicted > self.device_memory_bytes:
+                self.metrics.inc("serve_rejected_memory")
+                body: Dict[str, Any] = {
+                    "error": (
+                        f"predicted {engine} footprint {predicted} bytes "
+                        f"exceeds available device memory "
+                        f"{self.device_memory_bytes} bytes"
+                    ),
+                    "predicted_bytes": int(predicted),
+                    "available_bytes": int(self.device_memory_bytes),
+                    "engine": engine,
+                }
+                alt = obs_memory.recommend_engine(
+                    model, self.device_memory_bytes
+                )
+                if alt is not None:
+                    body["recommended_engine"] = alt
+                return 413, body
 
         options: Dict[str, Any] = {}
         if payload.get("target_max_depth") is not None:
@@ -537,6 +582,52 @@ class RunService:
         self._tenant_submits.setdefault(tenant, deque()).append(
             time.monotonic()
         )
+
+    def _predicted_bytes(self, model, engine: str) -> Optional[int]:
+        """Planner prediction for ONE job of this model at the service's
+        configured geometry; None when the engine has no device footprint
+        (host bfs) or the model refuses to plan."""
+        try:
+            if engine == "multiplex":
+                p = obs_memory.plan(
+                    model, engine="multiplex", lanes=1,
+                    chunk=self.lane_options["chunk"],
+                    queue_capacity=self.lane_options["queue_capacity"],
+                    table_capacity=self.lane_options["table_capacity"],
+                )
+            elif engine == "tpu_bfs":
+                p = obs_memory.plan(
+                    model, engine="tpu_bfs",
+                    chunk=self.solo_options["chunk_size"],
+                    queue_capacity=self.solo_options["queue_capacity"],
+                    table_capacity=self.solo_options["table_capacity"],
+                )
+            else:
+                return None
+            return int(p["total_bytes"])
+        except Exception:
+            return None  # planning is advisory; never block on its bugs
+
+    def _lane_budget(self, model) -> int:
+        """How many multiplex lanes of this model the device budget fits
+        (obs/memory.max_lanes_for_budget); the configured lane count when
+        no limit is known."""
+        if self.device_memory_bytes is None or model is None:
+            return self.lanes
+        try:
+            n = obs_memory.max_lanes_for_budget(
+                model, self.device_memory_bytes,
+                lanes=self.lanes,
+                chunk=self.lane_options["chunk"],
+                queue_capacity=self.lane_options["queue_capacity"],
+                table_capacity=self.lane_options["table_capacity"],
+            )
+        except Exception:
+            return self.lanes
+        if n < self.lanes:
+            self.metrics.inc("serve_lanes_rightsized")
+        self.metrics.set_gauge("serve_lane_budget", n)
+        return n
 
     def _lint(self, spec: str, signature: Optional[str], model: Any):
         key = signature or f"spec:{spec}"
@@ -701,11 +792,16 @@ class RunService:
             return None
         batch = [job]
         if job.engine == "multiplex":
+            # Footprint-based right-sizing: gather no more same-signature
+            # lanes than the device budget fits (obs/memory) — the rest
+            # stay queued for the next batch instead of overcommitting.
+            budget = self._lane_budget(job.model)
             keep = []
             for entry in self._heap:
                 mate = entry[2]
                 if (
-                    mate.status == "queued"
+                    len(batch) < budget
+                    and mate.status == "queued"
                     and mate.engine == "multiplex"
                     and mate.signature == job.signature
                 ):
@@ -806,6 +902,19 @@ class RunService:
         of attempts — fails for real."""
         msg = f"{type(exc).__name__}: {exc}"
         transient, escalate = classify_failure(msg)
+        if is_oom(msg):
+            # OOM post-mortem: engines that died before reporting a
+            # ledger snapshot (e.g. a multiplex compile-time OOM) still
+            # get the planner's predicted residency recorded.
+            for j in jobs:
+                if j.memory_at_failure is None:
+                    predicted = self._predicted_bytes(j.model, j.engine)
+                    if predicted is not None:
+                        j.memory_at_failure = {
+                            "source": "plan",
+                            "engine": j.engine,
+                            "total_bytes": predicted,
+                        }
         retriable = [
             j for j in jobs
             if transient and j.attempts < self.retry.max_attempts
@@ -843,6 +952,7 @@ class RunService:
             if self._stop or job.status != "queued":
                 return  # cancelled (or service stopping) while backing off
             job.error = None
+            job.memory_at_failure = None  # fresh attempt, fresh post-mortem
             now = time.time()
             if job.backoff_since is not None:
                 # The wait itself is part of the job's story: a span in
@@ -886,7 +996,12 @@ class RunService:
             ):
                 self._results.put(j.id, j.result)
             if self._journal is not None:
-                self._journal.result(j.id, status, error=j.error)
+                self._journal.result(
+                    j.id, status, error=j.error,
+                    memory=(
+                        j.memory_at_failure if error is not None else None
+                    ),
+                )
             done_at = time.time()
             if self._results is not None or self._journal is not None:
                 self.spans.record(
@@ -1021,7 +1136,15 @@ class RunService:
             builder.spans(
                 self.spans, trace_id=job.trace_id, parent_id=exec_span_id
             )
-            checker = compiled.spawn(builder).join()
+            checker = compiled.spawn(builder)
+            try:
+                checker.join()
+            except Exception as e:
+                # An OOM death still has a live memory ledger on the
+                # engine: snapshot it onto the job before the failure
+                # path journals it.
+                self._note_memory_at_failure(job, checker, e)
+                raise
         else:  # host bfs
             hit = False
             builder = job.model.checker()
@@ -1037,6 +1160,18 @@ class RunService:
             span_id=exec_span_id, attach_phases=False,
         )
         self._finish([job])
+
+    def _note_memory_at_failure(self, job: Job, checker, exc) -> None:
+        """Capture the engine's memory-ledger snapshot onto an
+        OOM-failed job so `GET /jobs/{id}` shows post-mortem residency."""
+        if not is_oom(f"{type(exc).__name__}: {exc}"):
+            return
+        try:
+            snap = (checker.telemetry() or {}).get("memory")
+        except Exception:
+            snap = None
+        if snap:
+            job.memory_at_failure = {"source": "ledger", **snap}
 
     def _result_payload(self, job: Job, checker) -> Dict[str, Any]:
         model = checker.model()

@@ -1,0 +1,483 @@
+"""Memory observability (obs/memory.py): ledger parity, forecaster,
+capacity planner, and the serve/speclint/reporter integrations.
+
+The load-bearing invariant is EXACT parity: the analytic ledger must
+equal ``sum(arr.nbytes)`` over the live device buffers on every engine,
+including across table growth and queue spill — an approximate ledger
+is worse than none, because operators size hardware off it. The planner
+is the same arithmetic run before dispatch, so plan == ledger at equal
+geometry is also exact, not approximate.
+"""
+
+import io
+import json
+
+import pytest
+
+from stateright_tpu import Model, TensorModelAdapter
+from stateright_tpu.has_discoveries import HasDiscoveries
+from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
+from stateright_tpu.obs.memory import (
+    Forecaster,
+    MemoryRecorder,
+    device_memory_bytes,
+    main as plan_main,
+    max_lanes_for_budget,
+    plan,
+    recommend_engine,
+)
+
+# ---------------------------------------------------------------------------
+# Shared runs (module-scoped: the growth/spill space is 8832 states)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def growth_checker():
+    """Tiny table (forces growth) + tiny queue (forces spill) on the
+    2pc-5 space — the ledger must track every regrow and spill block."""
+    return (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .spawn_tpu_bfs(table_capacity=1 << 8, queue_capacity=1 << 10, chunk_size=64)
+        .join()
+    )
+
+
+@pytest.fixture(scope="module")
+def bfs3_checker():
+    """2pc-3 at a fixed no-growth geometry (the grow trigger reserves
+    max_actions*chunk rows, so the table must be comfortably larger than
+    that), mirrored by the planner test."""
+    return (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .spawn_tpu_bfs(
+            table_capacity=1 << 15, queue_capacity=1 << 12, chunk_size=256
+        )
+        .join()
+    )
+
+
+def _device_component_bytes(snap):
+    return {
+        name: c["bytes"]
+        for name, c in snap["components"].items()
+        if c["kind"] == "device"
+    }
+
+
+# ---------------------------------------------------------------------------
+# Ledger parity on all three device engines
+# ---------------------------------------------------------------------------
+
+
+def test_tpu_bfs_ledger_parity_across_growth_and_spill(growth_checker):
+    c = growth_checker
+    assert c.unique_state_count() == 8832
+    snap = c.telemetry()["memory"]
+    # EXACT: analytic bytes == nbytes over the live buffers, after growth.
+    assert snap["total_bytes"] == c._memory.ledger.live_nbytes()
+    assert snap["total_bytes"] > 0
+    events = snap["events"]
+    kinds = {e["event"] for e in events}
+    resizes = [
+        e
+        for e in events
+        if e["event"] == "resize" and e["component"] == "visited_table"
+    ]
+    assert resizes, "the 1<<8 table must have regrown on 8832 states"
+    for e in resizes:
+        assert e["to_bytes"] > e["from_bytes"]
+    # The 1<<12 queue must have spilled to host staging and refilled.
+    assert "spill" in kinds and "refill" in kinds
+    assert snap["peak_bytes"] >= snap["total_bytes"]
+
+
+def test_flight_records_carry_memory(growth_checker):
+    records = growth_checker.flight()
+    assert records
+    for rec in records:
+        mem = rec["memory"]
+        assert mem["total_bytes"] > 0
+        assert mem["by_component"]["visited_table"] > 0
+
+
+def test_memory_snapshot_is_json_serializable(growth_checker):
+    json.dumps(growth_checker.telemetry()["memory"])
+
+
+def test_tpu_simulation_ledger_parity():
+    c = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .finish_when(HasDiscoveries.any_of(["fin"]))
+        .spawn_tpu_simulation(7, walks=64, walk_cap=64)
+        .join()
+    )
+    snap = c.telemetry()["memory"]
+    assert snap["total_bytes"] == c._memory.ledger.live_nbytes()
+    comps = _device_component_bytes(snap)
+    assert comps["walk_lanes"] > 0
+    assert comps["path_fps"] > 0
+
+
+def test_sharded_ledger_parity():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .spawn_sharded_bfs(
+            chunk_size=128,
+            queue_capacity_per_shard=1 << 12,
+            table_capacity_per_shard=1 << 10,
+        )
+        .join()
+    )
+    assert c.unique_state_count() == 288
+    snap = c.telemetry()["memory"]
+    assert snap["total_bytes"] == c._memory.ledger.live_nbytes()
+    assert snap["total_bytes"] > 0
+
+
+def test_memory_off_builder():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(3))
+        .checker()
+        .memory(False)
+        .spawn_tpu_bfs()
+        .join()
+    )
+    assert "memory" not in c.telemetry()
+
+
+# ---------------------------------------------------------------------------
+# Planner: plan == ledger at equal geometry
+# ---------------------------------------------------------------------------
+
+
+def test_plan_matches_ledger_exactly(bfs3_checker):
+    snap = bfs3_checker.telemetry()["memory"]
+    assert not any(e["event"] == "resize" for e in snap["events"])
+    p = plan(
+        TensorModelAdapter(TwoPhaseTensor(3)),
+        engine="tpu_bfs",
+        chunk=256,
+        queue_capacity=1 << 12,
+        table_capacity=1 << 15,
+    )
+    planned = {name: c["bytes"] for name, c in p["components"].items()}
+    assert _device_component_bytes(snap) == planned
+    assert snap["total_bytes"] == p["total_bytes"]
+
+
+def test_plan_engine_aliases_and_fit():
+    m = TensorModelAdapter(TwoPhaseTensor(3))
+    assert plan(m, engine="mesh")["components"] == plan(m, engine="sharded")[
+        "components"
+    ]
+    assert plan(m, engine="bfs")["engine"] == plan(m, engine="tpu_bfs")["engine"]
+    p = plan(m, engine="tpu_bfs", device_limit_bytes=1000)
+    assert p["fits"] is False and p["headroom_bytes"] < 0
+    p2 = plan(m, engine="tpu_bfs", device_limit_bytes=p["total_bytes"])
+    assert p2["fits"] is True
+    # Per-lane arithmetic on the multiplex engine.
+    pm = plan(m, engine="multiplex", lanes=4)
+    assert pm["per_lane_bytes"] == pm["total_bytes"] // 4
+
+
+def test_plan_rejects_host_only_models():
+    class HostOnly(Model):
+        def init_states(self):
+            return [0]
+
+        def actions(self, state, actions):
+            pass
+
+        def next_state(self, state, action):
+            return state
+
+        def properties(self):
+            return []
+
+    with pytest.raises(TypeError):
+        plan(HostOnly())
+
+
+def test_recommend_engine_order_and_budget():
+    m = TensorModelAdapter(TwoPhaseTensor(3))
+    totals = {
+        e: plan(m, engine=e)["total_bytes"]
+        for e in ("tpu_bfs", "sharded", "tpu_simulation")
+    }
+    big = max(totals.values())
+    assert recommend_engine(m, big) == "tpu_bfs"
+    assert recommend_engine(m, 100) is None  # nothing fits in 100 bytes
+    if totals["sharded"] <= big:
+        assert recommend_engine(m, big, exclude=("tpu_bfs",)) == "sharded"
+
+
+def test_max_lanes_for_budget():
+    m = TensorModelAdapter(IncrementTensor(2))
+    per_lane = plan(m, engine="multiplex", lanes=1)["total_bytes"]
+    # No known limit -> the configured lane count, untouched.
+    assert max_lanes_for_budget(m, None) == 32
+    assert max_lanes_for_budget(m, None, lanes=8) == 8
+    # A budget under one lane still grants one (the job must run somewhere).
+    assert max_lanes_for_budget(m, per_lane) == 1
+    # Plenty of budget -> capped at the configured lanes.
+    assert max_lanes_for_budget(m, per_lane * 100, lanes=8) == 8
+
+
+def test_plan_cli(capsys):
+    assert plan_main(["2pc:3", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fits"] is None or doc["fits"] is True  # no limit known on CPU
+    assert doc["total_bytes"] > 0
+
+    assert plan_main(["2pc:3", "--limit-bytes", "1000"]) == 3
+    out = capsys.readouterr().out
+    assert "DOES NOT FIT" in out
+
+    with pytest.raises(SystemExit):
+        plan_main(["no-such-model:1"])
+
+
+# ---------------------------------------------------------------------------
+# Forecaster
+# ---------------------------------------------------------------------------
+
+
+def test_forecaster_geometric_growth_and_exhaustion():
+    f = Forecaster()
+    for u in (10, 30, 70, 150, 310):
+        f.observe(u)
+    r, d = f.fit()
+    assert r == pytest.approx(2.0)
+    assert d == 160
+    base = dict(
+        unique=310,
+        rows=4096,
+        max_load=0.25,
+        reserve_rows=0,
+        table_bytes=4096 * 8,
+    )
+    fc = f.forecast(**base)
+    # 310 -> 470 -> 790 -> 1430 crosses 0.25*4096=1024 at era 3.
+    assert fc["eras_to_grow"] == 3
+    assert fc["eras_to_exhaustion"] is None
+    assert fc["projected_unique"] is None  # diverging (r >= 1)
+    fc = f.forecast(**base, device_limit=40_000)
+    # The era-3 doubling (32768 -> 65536 bytes) crosses the 40k limit.
+    assert fc["eras_to_exhaustion"] == 3
+
+
+def test_forecaster_plateau():
+    f = Forecaster()
+    for u in (100, 180, 220, 240):
+        f.observe(u)
+    r, d = f.fit()
+    assert r == pytest.approx(0.5)
+    assert d == 20
+    fc = f.forecast(
+        unique=240,
+        rows=1 << 20,
+        max_load=0.9,
+        reserve_rows=0,
+        table_bytes=8 << 20,
+        device_limit=1 << 30,
+    )
+    # Decaying deltas converge: u + d*r/(1-r) = 240 + 20 = 260.
+    assert fc["projected_unique"] == 260
+    assert fc["eras_to_grow"] is None
+    assert fc["eras_to_exhaustion"] is None
+    assert fc["projected_table_bytes"] == 8 << 20
+
+
+def test_forecaster_needs_three_observations():
+    f = Forecaster()
+    f.observe(10)
+    f.observe(20)
+    assert f.fit() == (None, None)
+    fc = f.forecast(
+        unique=20, rows=64, max_load=0.5, reserve_rows=0, table_bytes=512
+    )
+    assert fc["ratio"] is None and fc["eras_to_grow"] is None
+
+
+def test_recorder_one_shot_warning():
+    rec = MemoryRecorder(engine="TpuBfsChecker", device_limit_bytes=100_000)
+    rec.ledger.register("visited_table", nbytes=60_000)
+    rec.on_era(unique=10, load_factor=0.1)
+    # Headroom (40k) cannot fit the next table doubling (60k) -> warn.
+    first = rec.warning
+    assert first is not None
+    assert "device memory pressure" in first
+    assert "regrow now" in first
+    rec.on_era(unique=20, load_factor=0.2)
+    assert rec.warning is first  # one-shot: never rewritten
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: 413 admission, lane right-sizing, OOM post-mortem
+# ---------------------------------------------------------------------------
+
+
+def test_serve_memory_admission_413():
+    from stateright_tpu.serve import RunService
+
+    svc = RunService(workers=1, lint_samples=16, device_memory_bytes=1024)
+    try:
+        svc.pause()
+        code, body = svc.submit({"spec": "2pc:3"})
+        assert code == 413, body
+        assert body["predicted_bytes"] > body["available_bytes"] == 1024
+        assert body["engine"] == "multiplex"
+        assert svc.metrics.snapshot()["serve_rejected_memory"] == 1
+    finally:
+        svc.shutdown()
+
+
+def test_serve_lane_rightsizing():
+    from stateright_tpu.serve import RunService
+
+    m = TensorModelAdapter(IncrementTensor(2))
+    per_lane = plan(
+        m,
+        engine="multiplex",
+        lanes=1,
+        chunk=256,
+        queue_capacity=1 << 13,
+        table_capacity=1 << 16,
+    )["total_bytes"]
+    # A budget that fits exactly two lanes (after the 0.9 safety factor).
+    limit = int(per_lane * 2 / 0.9) + 2
+    svc = RunService(
+        workers=1, lanes=8, lint_samples=16, device_memory_bytes=limit
+    )
+    try:
+        svc.pause()
+        for _ in range(4):
+            code, body = svc.submit({"spec": "increment:2"})
+            assert code == 202, body
+        with svc._cv:
+            batch = svc._pop_batch()
+        # 4 same-signature lanes queued, but only 2 fit the budget.
+        assert len(batch) == 2
+        snap = svc.metrics.snapshot()
+        assert snap["serve_lane_budget"] == 2
+        assert snap["serve_lanes_rightsized"] >= 1
+    finally:
+        svc.shutdown()
+
+
+def test_is_oom_classifier():
+    from stateright_tpu.serve.durability import is_oom
+
+    assert is_oom("RuntimeError: RESOURCE_EXHAUSTED: out of memory")
+    assert is_oom("XlaRuntimeError: Out of memory allocating 123 bytes")
+    assert not is_oom("ValueError: bad spec")
+    assert not is_oom("TimeoutError: deadline")
+
+
+def test_oom_postmortem_journal(tmp_path):
+    from stateright_tpu.serve import RunService
+    from stateright_tpu.serve.durability import RetryPolicy
+
+    journal = str(tmp_path / "serve.journal")
+    svc = RunService(
+        workers=1,
+        lint_samples=16,
+        journal_path=journal,
+        retry=RetryPolicy(max_attempts=1),
+    )
+    try:
+        svc.pause()
+        code, body = svc.submit({"spec": "2pc:3"})
+        assert code == 202, body
+        job_id = body["job_id"]
+        job = svc._jobs[job_id]
+        job.attempts = 1  # out of attempts -> the failure is terminal
+        svc._handle_failure(
+            [job], RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        )
+        assert job.status == "failed"
+        mem = job.memory_at_failure
+        assert mem is not None
+        assert mem["source"] == "plan" and mem["total_bytes"] > 0
+        assert job.view()["memory_at_failure"] == mem
+    finally:
+        svc.shutdown()
+
+    # The post-mortem must survive a service restart via the journal.
+    svc2 = RunService(workers=1, lint_samples=16, journal_path=journal)
+    try:
+        restored = svc2._jobs[job_id]
+        assert restored.status == "failed"
+        assert restored.memory_at_failure["source"] == "plan"
+        assert restored.memory_at_failure["total_bytes"] == mem["total_bytes"]
+    finally:
+        svc2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Explorer, Prometheus, reporter, speclint
+# ---------------------------------------------------------------------------
+
+
+def test_explorer_memory_view_and_prom_series(bfs3_checker):
+    from stateright_tpu.explorer.server import _memory_view, _metrics_prometheus
+
+    view = _memory_view(bfs3_checker)
+    assert view["memory"]["components"]["visited_table"]["bytes"] > 0
+    prom = _metrics_prometheus(bfs3_checker)
+    assert 'memory_bytes{component="visited_table"}' in prom
+
+
+def test_write_reporter_memory_line():
+    from stateright_tpu.report import ReportData, WriteReporter
+
+    buf = io.StringIO()
+    reporter = WriteReporter(buf)
+    reporter.report_checking(
+        ReportData(
+            total_states=10,
+            unique_states=5,
+            max_depth=3,
+            duration_secs=1.0,
+            done=True,
+            telemetry={
+                "eras": 3,
+                "memory": {
+                    "total_bytes": 1000,
+                    "peak_bytes": 1200,
+                    "host_bytes": 64,
+                    "headroom_bytes": 500,
+                    "forecast": {"eras_to_exhaustion": 7},
+                    "warning": "device memory pressure: test",
+                },
+            },
+        )
+    )
+    out = buf.getvalue()
+    assert "Memory. resident_bytes=1000, peak_bytes=1200" in out
+    assert "host_bytes=64" in out
+    assert "eta_exhaustion_eras=7" in out
+    assert "Warning. device memory pressure" in out
+    # The nested snapshot must NOT bloat the flat telemetry pairs line.
+    telemetry_line = next(l for l in out.splitlines() if l.startswith("Telemetry."))
+    assert "total_bytes" not in telemetry_line
+
+
+def test_speclint_str208_footprint(monkeypatch):
+    from stateright_tpu.analysis import analyze
+
+    monkeypatch.setenv("STPU_DEVICE_MEMORY_BYTES", "4096")
+    assert device_memory_bytes() == 4096
+    report = analyze(TwoPhaseTensor(3))
+    assert "STR208" in report.counts_by_code()
+    assert report.ok  # a warning, not an error
+
+    monkeypatch.delenv("STPU_DEVICE_MEMORY_BYTES")
+    if device_memory_bytes() is None:  # CPU hosts: no limit, no finding
+        report = analyze(TwoPhaseTensor(3))
+        assert "STR208" not in report.counts_by_code()
