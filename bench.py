@@ -939,6 +939,40 @@ def main() -> int:
     assert plan_err_pct <= 15.0, detail["tpc7_memory"]
     assert mem_overhead_pct < 1.0, detail["tpc7_memory"]
 
+    # Space-sampling cost: the headline runs sample by default (bottom-k
+    # state sampling, obs/sample.py — the candidate slab rides the era
+    # carry and drains on the existing packed-params readback, zero
+    # extra round-trips), so the control is the same workload with
+    # .sample(False). Budget asserted on each side's BEST of 3 (the
+    # memory section's noise-floor idiom: a real fixed cost survives at
+    # the noise floor, per-run scheduler jitter does not). Acceptance:
+    # sampling costs < 2%, and the headline sample is full at k=64.
+    TensorModelAdapter(tm7).checker().sample(False).spawn_tpu_bfs(
+        **opts
+    ).join()  # compile
+    med7sm, spread7sm, dev7sm = timed3(
+        lambda: (
+            TensorModelAdapter(tm7).checker().sample(False)
+            .spawn_tpu_bfs(**opts)
+        ),
+        golden=tpc7_golden,
+    )
+    rate_sm_off = dev7sm.state_count() / med7sm
+    rate_sm_off_best = dev7sm.state_count() / spread7sm[0]
+    sample_overhead_pct = (1.0 - rate_on_best / rate_sm_off_best) * 100.0
+    space7 = dev7.telemetry().get("space") or {}
+    detail["tpc7_sample"] = {
+        "states_per_sec_sample_on": round(dev_rate, 1),
+        "states_per_sec_sample_off": round(rate_sm_off, 1),
+        "space_sample_overhead_pct": round(sample_overhead_pct, 2),
+        "samples": space7.get("samples", 0),
+        "est_states": space7.get("est_states", 0),
+        "device_drops": space7.get("device_drops", 0),
+    }
+    assert space7.get("samples") == space7.get("k"), detail["tpc7_sample"]
+    assert not space7.get("degraded"), detail["tpc7_sample"]
+    assert sample_overhead_pct < 2.0, detail["tpc7_sample"]
+
     # Stage profile: ONE extra run with `.stage_profile()` — kept out of
     # the timed3 window above so the isolated-stage microbenches (a few
     # extra dispatches at era shapes) never pollute the headline rate.

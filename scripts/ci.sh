@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Eleven stages, fail-fast:
+# Twelve stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml),
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
@@ -38,7 +38,11 @@
 #      the live buffers' nbytes EXACTLY and the planner's prediction,
 #      and the `memory_bytes{component=...}` series must render in the
 #      Prometheus exposition,
-#  11. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#  11. a space smoke: the deterministic bottom-k state sample from a
+#      pipelined device run must equal the host oracle's sample
+#      EXACTLY, the profile must carry field sketches, and the
+#      `space_*` gauges must render in the Prometheus exposition,
+#  12. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -389,6 +393,52 @@ assert 'memory_bytes{component="visited_table"}' in prom, prom[:400]
 print(
     f"memory smoke OK: plan == ledger == nbytes == {p['total_bytes']} B "
     f"across {len(snap['components'])} components"
+)
+PY
+
+echo "== space smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
+from stateright_tpu.obs.metrics import render_prometheus
+
+# The sample is a pure function of the explored set: the pipelined
+# device run must produce the host oracle's sample bit-for-bit.
+host = (
+    TensorModelAdapter(TwoPhaseTensor(4)).checker().sample(k=64)
+    .spawn_bfs().join()
+)
+dev = (
+    TensorModelAdapter(TwoPhaseTensor(4)).checker().sample(k=64)
+    .spawn_tpu_bfs(chunk_size=64, queue_capacity=1 << 12,
+                   table_capacity=1 << 11)
+    .join()
+)
+assert dev.unique_state_count() == 1568, dev.unique_state_count()
+hfps, dfps = host._sampler.fingerprints(), dev._sampler.fingerprints()
+assert dfps == hfps, "device sample diverged from the host oracle"
+assert not dev._sampler.degraded
+
+profile = dev.space_profile()
+assert profile["fields"], profile.keys()
+assert profile["unresolved"] == 0, profile["unresolved"]
+assert profile["depths"] and profile["actions"]
+
+# Below k the sample IS the space: KMV estimate exact on increment.
+tiny = (
+    TensorModelAdapter(IncrementTensor(2)).checker().sample(k=64)
+    .spawn_bfs().join()
+)
+assert tiny.telemetry()["space"]["est_states"] == 13
+
+# The flat gauges must land in the Prometheus exposition.
+prom = render_prometheus(dev.telemetry())
+assert "space_samples 64" in prom, prom[:400]
+assert "space_est_states" in prom, prom[:400]
+print(
+    f"space smoke OK: 64-sample parity on 2pc-4, "
+    f"est_states={profile['est_states']}, "
+    f"{len(profile['fields'])} field sketches"
 )
 PY
 

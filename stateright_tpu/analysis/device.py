@@ -20,6 +20,13 @@ Codes:
   STR208  default-geometry device footprint exceeds this host's device
           memory (obs/memory.py capacity planner) — the run would OOM
           mid-era; the finding names a fitting alternative engine
+  STR209  a state lane's sampled maximum sits exactly at a packing
+          boundary (2^b - 1 for b in 8/16/24/32) — the field has likely
+          saturated its encoding and larger values would silently wrap
+          or clamp, merging distinct states. Shares its detector with
+          the runtime space profile (obs/sample.py detect_saturation),
+          so the static pre-flight and the live run flag the same
+          condition
 """
 
 from __future__ import annotations
@@ -68,6 +75,30 @@ def run(tm: TensorModel, rows: np.ndarray, report: AnalysisReport) -> None:
         _check_host_device_agreement(tm, lanes, np_out, report)
     _check_boundary(tm, lanes, report)
     _check_decode(tm, rows, report)
+    _check_saturation(tm, rows, report)
+
+
+def _check_saturation(
+    tm: TensorModel, rows: np.ndarray, report: AnalysisReport
+) -> None:
+    """STR209: sampled lane maxima sitting exactly at a packing boundary
+    (ONE shared implementation with the runtime detector — obs/sample.py
+    detect_saturation — so lint and live profile agree by construction)."""
+    from ..obs.sample import detect_saturation
+
+    for ent in detect_saturation(rows.astype(np.uint64)):
+        report.add(
+            "STR209",
+            Severity.WARNING,
+            f"state lane {ent['lane']} saturates its {ent['bits']}-bit "
+            f"packing: {ent['hits']} of {rows.shape[0]} sampled states "
+            f"hold the boundary value {ent['max']} (= 2^{ent['bits']}-1); "
+            "larger values would wrap or clamp and distinct states would "
+            "merge",
+            _loc(tm, "step_lanes"),
+            "widen the field across lanes or verify the domain really "
+            f"tops out below 2^{ent['bits']}",
+        )
 
 
 def _check_init_array(tm: TensorModel, report: AnalysisReport, S: int) -> bool:

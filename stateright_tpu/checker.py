@@ -66,6 +66,8 @@ class CheckerBuilder:
         self.flight_format_: str = "jsonl"
         self.memory_: bool = True
         self.pipeline_: bool = True
+        self.sample_: bool = True
+        self.sample_k_: int = 64  # obs/sample.py DEFAULT_SAMPLE_K
 
     # -- options ------------------------------------------------------------
 
@@ -184,6 +186,24 @@ class CheckerBuilder:
         ``telemetry()["memory"]``, ``memory_bytes{component=...}``
         Prometheus gauges, and the Explorer's ``GET /memory``."""
         self.memory_ = enable
+        return self
+
+    def sample(self, enable: bool = True, k: int = 64) -> "CheckerBuilder":
+        """Configure the space profiler (obs/sample.py): deterministic
+        bottom-k fingerprint sampling of the explored state space. A
+        state is sampled iff its 64-bit fingerprint is among the `k`
+        smallest seen, so the sample set is a pure function of the
+        explored set — identical across engines (host bfs == tpu_bfs ==
+        sharded mesh, locked by tests), visitation orders, shard
+        layouts, and pipelining. On by default at small k (<2% overhead
+        on the device engines, asserted by bench.py; candidates ride
+        the existing once-per-era packed-params readback). Surfaced via
+        `Checker.space_profile()` (field-distribution sketches, depth/
+        action exemplars, packing-saturation warnings),
+        ``telemetry()["space"]``, flat ``space_*`` gauges, and the
+        Explorer's ``GET /space`` panel."""
+        self.sample_ = bool(enable)
+        self.sample_k_ = max(1, int(k))
         return self
 
     def multiplex_lane(self, enable: bool = True) -> "CheckerBuilder":
@@ -432,6 +452,14 @@ class Checker:
         Engines without an era loop return []."""
         return []
 
+    def space_profile(self) -> Dict[str, Any]:
+        """The run's space profile (obs/sample.py): the deterministic
+        bottom-k sample of the explored state space rendered into
+        per-field distribution sketches, per-depth exemplar states,
+        per-action exemplar transitions, and packing-saturation
+        warnings. Engines without sampling support return {}."""
+        return {}
+
     # -- on-demand engine hooks (no-ops elsewhere; checker.rs:298-306) ------
 
     def check_fingerprint(self, fingerprint: int) -> None:
@@ -493,6 +521,7 @@ class Checker:
                 done=True,
                 telemetry=self.telemetry(),
                 coverage=self.coverage(),
+                space=self.space_profile(),
             )
         )
         discoveries = {

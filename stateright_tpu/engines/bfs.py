@@ -29,7 +29,10 @@ class BfsChecker(HostEngineBase):
         # visited: fingerprint -> Optional[parent fingerprint] (bfs.rs:29-30)
         self._generated: Dict[int, Optional[int]] = {}
         for s in init_states:
-            self._generated.setdefault(self._fp(s), None)
+            fp = self._fp(s)
+            if fp not in self._generated and self._sampler is not None:
+                self._sampler.offer(fp, depth=1, state=s)
+            self._generated.setdefault(fp, None)
         self._coverage.record_depth(1, len(self._generated))
         # job: (state, fingerprint, ebits, depth) (bfs.rs:33)
         self._pending = deque(
@@ -104,6 +107,14 @@ class BfsChecker(HostEngineBase):
                     is_terminal = False
                     continue
                 generated[next_fp] = state_fp
+                if self._sampler is not None:
+                    self._sampler.offer(
+                        next_fp,
+                        depth=depth + 1,
+                        action=action,
+                        state=next_state,
+                        pred=state,
+                    )
                 if cov is not None:
                     cov.record_depth(depth + 1)
                 is_terminal = False

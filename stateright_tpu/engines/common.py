@@ -120,6 +120,20 @@ class HostEngineBase(Checker):
             if getattr(builder, "memory_", True)
             else None
         )
+        # Space sampler (obs/sample.py): deterministic bottom-k
+        # fingerprint sample of the explored space. Host engines offer at
+        # visited-insertion; device engines drain their on-device
+        # candidate slab at the per-era readback. The sample set is a
+        # pure function of the explored set, so every engine over the
+        # same model keeps the identical sample.
+        from ..obs.sample import DEFAULT_SAMPLE_K, SpaceSampler
+
+        self._sampler = (
+            SpaceSampler(k=getattr(builder, "sample_k_", DEFAULT_SAMPLE_K))
+            if getattr(builder, "sample_", True)
+            else None
+        )
+        self._space_profile_cache: Optional[Dict[str, Any]] = None
         # Span ledger (obs/spans.py) via CheckerBuilder.spans(): the whole
         # run becomes one "run" span with phase-timer children; the run
         # span's id is pre-assigned so per-era progress spans can parent to
@@ -215,6 +229,11 @@ class HostEngineBase(Checker):
                     max_depth=int(self._max_depth),
                     phase_ms=self._metrics.phase_ms(),
                     error=repr(self._error) if self._error else None,
+                    **(
+                        {"space": self._sampler.snapshot()}
+                        if self._sampler is not None and self._sampler.size()
+                        else {}
+                    ),
                 )
             self._flush_flight()
             if self._spans is not None:
@@ -329,6 +348,8 @@ class HostEngineBase(Checker):
                 self._metrics.set_gauge(
                     "coverage_dead_actions", len(self._coverage.dead_actions())
                 )
+        if self._sampler is not None and self._sampler.size():
+            self._sampler.set_gauges(self._metrics)
         snap = self._metrics.snapshot()
         if self._flight is not None:
             fsum = self._flight.summary()
@@ -336,6 +357,8 @@ class HostEngineBase(Checker):
                 snap["flight"] = fsum
         if self._memory is not None and self._memory.ledger.components():
             snap["memory"] = self._memory.snapshot()
+        if self._sampler is not None and self._sampler.size():
+            snap["space"] = self._sampler.snapshot()
         snap["engine"] = type(self).__name__
         return snap
 
@@ -347,6 +370,46 @@ class HostEngineBase(Checker):
         """Retained flight records (obs/flight.py), oldest first. Empty
         for engines without an era loop or when .flight(False) was set."""
         return self._flight.records() if self._flight is not None else []
+
+    def _sample_resolver(self):
+        """fp64 -> {"state","pred","action"} backfill for samples drained
+        fingerprint-only (device engines override with their path
+        reconstructor); None means rows were captured at offer time."""
+        return None
+
+    def _path_sample_resolver(self, reconstruct):
+        """Wrap an fp -> Path reconstructor into a sample resolver: the
+        path's final state is the sample, its last step the (pred,
+        action) exemplar transition, its length the BFS depth."""
+
+        def resolve(fp: int):
+            path = reconstruct(fp)
+            pairs = path.into_vec()
+            out = {"state": pairs[-1][0], "depth": len(pairs)}
+            if len(pairs) >= 2:
+                out["pred"], out["action"] = pairs[-2]
+            return out
+
+        return resolve
+
+    def space_profile(self) -> Dict[str, Any]:
+        """The run's space profile (obs/sample.py): the bottom-k sample
+        rendered into field sketches, depth/action exemplars, and
+        saturation warnings. Built on demand; cached once the run is
+        done (device engines resolve sample rows via path
+        reconstruction, which is worth doing once, not per poll)."""
+        if self._sampler is None or not self._sampler.size():
+            return {}
+        if self._space_profile_cache is not None:
+            return self._space_profile_cache
+        from ..obs.sample import build_space_profile
+
+        profile = build_space_profile(
+            self._model, self._sampler, resolver=self._sample_resolver()
+        )
+        if self.is_done():
+            self._space_profile_cache = profile
+        return profile
 
     def _flight_record(
         self,

@@ -151,14 +151,21 @@ class CompiledCheck:
                 qcap // (2 * max(1, tm.max_actions)),
             )
             cov = bool(self.options.get("coverage", True))
+            # Space sampling defaults ON at k=64 (CheckerBuilder.sample);
+            # warm the loop at the same shape a default run compiles.
+            from ..obs.sample import DEFAULT_SAMPLE_K
+
+            sample_k = int(self.options.get("sample_k", DEFAULT_SAMPLE_K))
             # Mirror the engine's proactive pre-growth so the seed loop is
             # traced at the table capacity a run will actually use.
             n_init = len(tm.init_states_array())
             vcap = _vcap(tm.max_actions, chunk)
             while n_init + vcap > vs.MAX_LOAD * tcap:
                 tcap *= 2
-            _build_loop(tm, props, chunk, qcap, False, cov)
-            _build_seed_loop(tm, props, chunk, qcap, tcap, False, cov)
+            _build_loop(tm, props, chunk, qcap, False, cov, sample_k=sample_k)
+            _build_seed_loop(
+                tm, props, chunk, qcap, tcap, False, cov, sample_k=sample_k
+            )
         elif self.engine == "multiplex":
             from .multiplex import warm_lane_program
 

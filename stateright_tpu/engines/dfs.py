@@ -44,10 +44,10 @@ class DfsChecker(HostEngineBase):
         self._state_count = len(init_states)
         self._generated: set = set()  # fingerprints (of representatives if symmetry)
         for s in init_states:
-            if symmetry is not None:
-                self._generated.add(self._fp(symmetry(s)))
-            else:
-                self._generated.add(self._fp(s))
+            fp = self._fp(symmetry(s)) if symmetry is not None else self._fp(s)
+            if fp not in self._generated and self._sampler is not None:
+                self._sampler.offer(fp, depth=1, state=s)
+            self._generated.add(fp)
         self._coverage.record_depth(1, len(self._generated))
         # job: (state, fingerprint cons-path, ebits, depth) (dfs.rs:31)
         self._pending = deque(
@@ -124,6 +124,7 @@ class DfsChecker(HostEngineBase):
                         is_terminal = False
                         continue
                     generated.add(rep_fp)
+                    sample_fp = rep_fp
                     # Continue the path with the pre-canonicalized fingerprint
                     # so the path stays extendable (dfs.rs:315-318).
                     next_fp = self._fp(next_state)
@@ -133,6 +134,18 @@ class DfsChecker(HostEngineBase):
                         is_terminal = False
                         continue
                     generated.add(next_fp)
+                    sample_fp = next_fp
+                if self._sampler is not None:
+                    # Sample by the dedup key (the canonical fingerprint
+                    # under symmetry) — the same key the device engines
+                    # explore, keeping sample sets engine-independent.
+                    self._sampler.offer(
+                        sample_fp,
+                        depth=depth + 1,
+                        action=action,
+                        state=next_state,
+                        pred=state,
+                    )
                 if cov is not None:
                     cov.record_depth(depth + 1)
                 is_terminal = False

@@ -437,6 +437,20 @@ class ParallelBfsChecker(HostEngineBase):
                 else:
                     ingest(msg)
             self._tables = [tables.get(w, {}) for w in range(n)]
+            if self._sampler is not None:
+                # Workers are separate processes, so sampling happens at
+                # the table merge: one vectorized bottom-k pass over each
+                # shard's visited fingerprints (rows/depths resolve
+                # lazily through _reconstruct at profile-build time).
+                import numpy as np
+
+                for table in self._tables:
+                    if table:
+                        self._sampler.offer_array(
+                            np.fromiter(
+                                table.keys(), dtype=np.uint64, count=len(table)
+                            )
+                        )
             self._state_count = sum(s["sc"] for s in stats.values())
             self._unique = sum(s["uniq"] for s in stats.values())
             self._max_depth = max(
@@ -458,6 +472,9 @@ class ParallelBfsChecker(HostEngineBase):
             name: self._reconstruct(fp)
             for name, fp in list(self._discovery_fps.items())
         }
+
+    def _sample_resolver(self):
+        return self._path_sample_resolver(self._reconstruct)
 
     def _reconstruct(self, fp: int) -> Path:
         """Walk parent pointers across the shard tables (owner = fp % N)."""

@@ -194,6 +194,13 @@ class SimulationChecker(HostEngineBase):
                 break  # found a loop
             generated.add(key)
             trace_states += 1
+            if self._sampler is not None:
+                # Walks revisit states across traces; the sampler dedups
+                # by fingerprint, so the sample is still a pure function
+                # of the VISITED set (depth = first-visit walk position).
+                self._sampler.offer(
+                    key, depth=len(fingerprint_path), state=state
+                )
             if cov is not None:
                 d = len(fingerprint_path)
                 trace_depths[d] = trace_depths.get(d, 0) + 1

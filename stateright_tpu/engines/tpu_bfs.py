@@ -121,6 +121,14 @@ P_LEN = 17
 
 
 _COV_W = 16  # relative depth-offset window of the era loop's histogram
+# Low-side slack of that window: the ring append lands children in
+# candidate (action-major) order, so a pop window spanning a BFS depth
+# boundary interleaves depth-(d+1) and depth-(d+2) children in the ring.
+# A later window's lane-0 row is then NOT its shallowest — inserts from
+# the shallower interleaved parents sit up to a few levels BELOW
+# depth[0]+1. _COV_LO buckets below the anchor absorb them exactly
+# (uint32-wrapped offsets compare exactly against their biased bucket).
+_COV_LO = 8
 
 # Adaptive era budget floor: the smallest per-era step budget the device
 # emission may shrink to under spill/grow pressure, and the slow-start
@@ -151,7 +159,7 @@ def _vcap(A: int, chunk: int) -> int:
 
 
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False,
-                cov: bool = True, raw: bool = False):
+                cov: bool = True, raw: bool = False, sample_k: int = 0):
     """Compile the BFS device "era" loop.
 
     Returns a jitted function
@@ -171,8 +179,19 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     donation): that is what the multiplexed lane engine
     (engines/multiplex.py) wraps in `jax.vmap` — an inner jit would defeat
     batching and donation is illegal on a vmapped operand it does not own.
+
+    With ``sample_k > 0`` the loop additionally maintains the bottom-k
+    space-sampling slab (obs/sample.py): every exactly-once insert whose
+    fingerprint is lexicographically below the host-supplied threshold
+    (read from the sample tail of the INPUT params — pass-through, so
+    chained speculative dispatches reuse a stale-but-looser threshold,
+    which only ever admits a superset of candidates) is appended to a
+    fixed in-carry slab; the epilogue ranks the slab by h1 via one
+    `top_k` and ships the smallest ``slab_entries(k)`` rows in the params
+    tail, so the drain rides the existing once-per-era readback with
+    ZERO extra round-trips. The host applies the exact 64-bit tie cut.
     """
-    key = (id(tm), chunk, qcap, len(props), canon, cov, raw)
+    key = (id(tm), chunk, qcap, len(props), canon, cov, raw, sample_k)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -196,6 +215,27 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     expand_lean = build_expand_lean(tm, props, chunk)
     qmask = qcap - 1
     vcap = _vcap(A, chunk)
+    if sample_k:
+        from ..obs.sample import (
+            DEVICE_STEP_CAP,
+            slab_capacity,
+            slab_entries,
+            slab_high_water,
+        )
+
+        sk2 = slab_entries(sample_k)  # entries drained per era
+        s_high = slab_high_water(sample_k)  # era-exit occupancy gate
+        scap = slab_capacity(sample_k, DEVICE_STEP_CAP)  # in-carry slab
+        # Loose-threshold take clamp: while the threshold is still MAX
+        # (sampler under-full — fresh runs only) EVERY insert is a
+        # candidate, so cap the pop so one step can never produce more
+        # than the per-step capture width (candidates <= take * A). This
+        # is what makes the sample EXACT from state one; once the host
+        # drains k entries the threshold tightens and the clamp is moot.
+        s_take = max(1, DEVICE_STEP_CAP // max(1, A))
+        # Input-params offsets of the threshold words (the sample tail
+        # starts right after the coverage tail; layout below).
+        s_base = P_LEN + 2 * P + (_cov_len(A, P) if cov else 0)
     # Distinct-candidate (probe + enqueue) width: 2/5 of the valid width
     # measured fastest on 2pc-7 (vcap/2 pays ~15% more probe width than
     # needed; vcap/3 sits under the distinct-count peaks and burns steps
@@ -220,6 +260,13 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
         fin_all = params[P_FIN_ALL]
         fin_all_en = params[P_FIN_ALL_EN]
         budget_cap = params[P_BUDGET_CAP]
+        if sample_k:
+            # Sampling threshold (exclusive; hi/lo uint32 words of the
+            # host sampler's 64-bit kth-smallest). Copied through to the
+            # output tail so chained dispatches keep a valid (stale =>
+            # looser => superset, host re-filters) threshold.
+            st1 = params[s_base]
+            st2 = params[s_base + 1]
         # The era is a data-dependent `lax.while_loop` whose predicate runs
         # ON DEVICE (measured round 4: a jitted while predicate costs
         # nothing extra — the old belief that it forced a host round-trip
@@ -245,11 +292,12 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             (
                 _table, _queue, _head, count, unique, _gen, steps,
                 err_cnt, _take_cap, rec_acc, _hseen, _f1, _f2, _fd, _covc,
+                sampc,
             ) = carry
             fin_hit = ((rec_acc & fin_any) != u(0)) | (
                 (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
             )
-            return (
+            keep = (
                 (count > u(0))
                 & (count <= high_water)
                 & (unique <= grow_limit)
@@ -257,6 +305,13 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 & (err_cnt == u(0))
                 & ~fin_hit
             )
+            if sample_k:
+                # Slab-occupancy gate: exit the era so the host can drain
+                # before the slab can overflow (one more step adds at most
+                # DEVICE_STEP_CAP entries, and scap = s_high + that).
+                # sampc[4] (occupied) is a uint32 sum chain — carry-safe.
+                keep = keep & (sampc[4] <= u(s_high))
+            return keep
 
         def body(carry):
             (
@@ -275,8 +330,16 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 facc2,
                 faccd,
                 covc,
+                sampc,
             ) = carry
             take = jnp.minimum(jnp.minimum(count, u(chunk)), take_cap)
+            if sample_k:
+                # Loose-threshold clamp (see the sizing block above): only
+                # binds while the sampler is under-full (threshold MAX).
+                loose = (st1 == u(0xFFFFFFFF)) & (st2 == u(0xFFFFFFFF))
+                take = jnp.minimum(
+                    take, jnp.where(loose, u(s_take), u(chunk))
+                )
             active = jnp.arange(chunk, dtype=jnp.uint32) < take
             popped, _idx = fr.ring_gather(queue, head, chunk)
             rows = popped[:S]
@@ -326,6 +389,51 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             )
             unres = unresolved.sum(dtype=jnp.uint32)
             new_count = c_new.sum(dtype=jnp.uint32)
+
+            if sample_k:
+                # Space-sampling capture: exactly-once inserts (c_new is
+                # exact even on retried partial steps — inserts commit
+                # once) whose 64-bit fingerprint is lexicographically
+                # below the threshold. Compacted to the small fixed
+                # capture width and appended to the slab; entries past
+                # the width are counted (sdrop) — astronomically rare
+                # under a tight threshold and impossible under a loose
+                # one thanks to the take clamp.
+                below = c_new & (
+                    (dh1 < st1) | ((dh1 == st1) & (dh2 < st2))
+                )
+
+                def _capture(sc):
+                    sfp1, sfp2, sdep, sact, socc, sdrp = sc
+                    cids, cvalid, n_c = vs._compact_ids(
+                        below, DEVICE_STEP_CAP
+                    )
+                    fit = jnp.minimum(n_c, u(DEVICE_STEP_CAP))
+                    pos = socc + jnp.arange(DEVICE_STEP_CAP, dtype=u)
+                    ok_w = cvalid & (pos < u(scap))
+                    # Masked lanes land in the trash slot (index scap) —
+                    # the slab lanes are scap+1 wide and the epilogue
+                    # reads [:scap] only.
+                    widx = jnp.where(ok_w, pos, u(scap))
+                    # flat id a*C+c -> action a
+                    dact = vids[dids] // u(chunk)
+                    return (
+                        sfp1.at[widx].set(dh1[cids]),
+                        sfp2.at[widx].set(dh2[cids]),
+                        sdep.at[widx].set(ddepth[cids]),
+                        sact.at[widx].set(dact[cids]),
+                        socc + fit,
+                        sdrp + (n_c - fit),
+                    )
+
+                # Once the threshold tightens (k-th smallest of the seen
+                # set) almost every step captures NOTHING — the cond
+                # skips the compaction and four slab scatters entirely,
+                # so steady-state sampling costs one compare + reduce
+                # per step.
+                sampc = lax.cond(
+                    below.any(), _capture, lambda sc: sc, sampc
+                )
 
             # Overflow (> vcap valid candidates, > rcap distinct
             # candidates, OR probe-tail overflow reported as unresolved
@@ -383,33 +491,51 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 # distinct-candidate width (XLA:CPU scatter-adds cost
                 # ~90ns/slot — 1.1ms/step at rcap width vs 0.13ms for this
                 # form, microbenched) AND the reduction->broadcast
-                # min-select the platform notes
-                # forbid in this carry: ring depth is NON-DECREASING, so
-                # the step's shallowest insert depth is depth[0]+1 — one
-                # lane read. Candidate depths then bucket into _COV_W
-                # relative offsets via plain masked uint32 sums (the
-                # carry-safe reduction pattern, same class as the
-                # discovery-gate sums) and ONE _COV_W-wide scatter lands
-                # them. Offsets past the window clamp into its last
-                # bucket — sum-exact always; a step would have to pop
-                # states spanning >= _COV_W BFS levels at once (>= _COV_W
-                # co-resident singleton levels) to smear a depth, which no
-                # bundled model comes near.
+                # min-select the platform notes forbid in this carry:
+                # candidate depths bucket into a fixed window of relative
+                # offsets around depth[0]+1 via plain masked uint32 sums
+                # (the carry-safe reduction pattern, same class as the
+                # discovery-gate sums) and ONE fixed-width scatter lands
+                # them. The window is TWO-SIDED: ring depth is only
+                # non-decreasing up to the interleaved zones the
+                # candidate-order append leaves at depth boundaries (see
+                # _COV_LO above), so lane 0 is an anchor, not a minimum —
+                # inserts up to _COV_LO levels below it count exactly via
+                # wrapped-offset equality. Offsets past either edge clamp
+                # into the boundary bucket — a step would have to pop
+                # states spanning >= _COV_W (or interleave >= _COV_LO)
+                # BFS levels at once to smear a depth, which no bundled
+                # model comes near.
                 act, covp, expanded, dhist = covc
                 pa = ex.valid.reshape(A, chunk).sum(axis=1, dtype=u)
                 act = act + jnp.where(ovf, u(0), pa)
                 expanded = expanded + consumed
                 dmin = depth[0] + u(1)
-                offs = ddepth - dmin
+                # Biased offset: soffs == _COV_LO <=> ddepth == dmin.
+                soffs = ddepth + u(_COV_LO) - dmin
+                under = soffs >= u(0x80000000)  # beyond the low-side slack
                 cnts = jnp.stack(
-                    [
-                        ((offs == u(w)) & c_new).sum(dtype=u)
-                        for w in range(_COV_W - 1)
+                    [(((soffs == u(0)) | under) & c_new).sum(dtype=u)]
+                    + [
+                        ((soffs == u(w)) & c_new).sum(dtype=u)
+                        for w in range(1, _COV_LO + _COV_W - 1)
                     ]
-                    + [((offs >= u(_COV_W - 1)) & c_new).sum(dtype=u)]
+                    + [
+                        (
+                            (soffs >= u(_COV_LO + _COV_W - 1))
+                            & ~under
+                            & c_new
+                        ).sum(dtype=u)
+                    ]
                 )
+                # Bucket w holds depth dmin - _COV_LO + w; saturate the
+                # subtraction at 0 (early eras have dmin < _COV_LO — the
+                # duplicate zero indices only ever receive zero counts,
+                # since no insert sits at depth < 2).
+                dd = dmin + jnp.arange(_COV_LO + _COV_W, dtype=u)
                 idx = jnp.minimum(
-                    dmin + jnp.arange(_COV_W, dtype=u), u(DEPTH_CAP - 1)
+                    jnp.where(dd >= u(_COV_LO), dd - u(_COV_LO), u(0)),
+                    u(DEPTH_CAP - 1),
                 )
                 dhist = dhist.at[idx].add(cnts)
                 covc = (act, covp, expanded, dhist)
@@ -459,6 +585,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 facc2,
                 faccd,
                 covc,
+                sampc,
             )
 
         zero_lane = jnp.zeros(chunk, dtype=jnp.uint32) + (head0 & u(0))
@@ -471,6 +598,19 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 jnp.zeros(DEPTH_CAP, dtype=jnp.uint32),  # depth histogram
             )
             if cov
+            else ()
+        )
+        sampc0 = (
+            (
+                # scap+1 wide: index scap is the masked-write trash slot.
+                jnp.zeros(scap + 1, dtype=jnp.uint32),  # fp1
+                jnp.zeros(scap + 1, dtype=jnp.uint32),  # fp2
+                jnp.zeros(scap + 1, dtype=jnp.uint32),  # depth
+                jnp.zeros(scap + 1, dtype=jnp.uint32),  # action index
+                u(0),  # occupied
+                u(0),  # dropped (per-step capture-width overflow)
+            )
+            if sample_k
             else ()
         )
         init = (
@@ -490,6 +630,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             tuple(zero_lane for _ in range(P)),
             tuple(zero_lane for _ in range(P)),
             covc0,
+            sampc0,
         )
         (
             table,
@@ -507,6 +648,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             facc2,
             faccd,
             covc_out,
+            sampc_out,
         ) = lax.while_loop(cond, body, init)
 
         # Block-level epilogue (runs ONCE per block, outside the loop, where
@@ -604,6 +746,27 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 expanded[None],
                 dhist,
             ]
+        if sample_k:
+            # Sample tail: [T1, T2, occupied, sdrop] + the sk2 smallest
+            # slab entries by h1 (one top_k in the once-per-block
+            # epilogue, where such reductions are cheap) with an explicit
+            # validity lane — a real fp1 of 0xFFFFFFFF keys to 0 and
+            # would otherwise be indistinguishable from padding. Ranking
+            # by h1 alone skips 64-bit compares on device; the sk2 - k
+            # pad rows plus the host's tie cut make the 64-bit bottom-k
+            # exact (obs/sample.py module doc).
+            sfp1, sfp2, sdep, sact, socc, sdrp = sampc_out
+            used = jnp.arange(scap, dtype=u) < socc
+            skey = jnp.where(used, ~sfp1[:scap], u(0))
+            _topv, topi = lax.top_k(skey, sk2)
+            parts += [
+                jnp.stack([st1, st2, socc, sdrp]),
+                sfp1[:scap][topi],
+                sfp2[:scap][topi],
+                sdep[:scap][topi],
+                sact[:scap][topi],
+                used[topi].astype(u),
+            ]
         params_out = jnp.concatenate(parts)
         return table, queue, rec_fp1, rec_fp2, params_out
 
@@ -621,7 +784,7 @@ _SEED_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
 def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
-                     canon: bool, cov: bool):
+                     canon: bool, cov: bool, sample_k: int = 0):
     """Fuse run seeding and the FIRST era into one jitted dispatch.
 
     On this platform every dispatch costs a ~100ms tunnel round-trip, and
@@ -631,7 +794,7 @@ def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
     a run whose discovery fires in era 1 (or that completes outright)
     never pays a second dispatch.
     """
-    key = (id(tm), chunk, qcap, tcap, len(props), canon, cov)
+    key = (id(tm), chunk, qcap, tcap, len(props), canon, cov, sample_k)
     cached = _SEED_LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -640,7 +803,7 @@ def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
 
     import jax
 
-    loop = _build_loop(tm, props, chunk, qcap, canon, cov)
+    loop = _build_loop(tm, props, chunk, qcap, canon, cov, sample_k=sample_k)
     seed = _build_seed(tm.state_width, qcap, tcap)
 
     @jax.jit
@@ -1030,9 +1193,12 @@ class TpuBfsChecker(HostEngineBase):
         if checkpoint_path is not None:
             register_signal_checkpoint_flush(self)
         self._cov = self._coverage.enabled
+        # Bottom-k space sampling (obs/sample.py): the compiled loop
+        # carries the capture slab only when the builder asked for it.
+        self._sample_k = self._sampler.k if self._sampler is not None else 0
         self._loop = _build_loop(
             self.tm, self._tprops, self._chunk, self._qcap, self._canon,
-            self._cov,
+            self._cov, sample_k=self._sample_k,
         )
 
         # Host-side bookkeeping.
@@ -1097,6 +1263,17 @@ class TpuBfsChecker(HostEngineBase):
         P = len(self._tprops)
         W = S + 2  # queue lanes: state | ebits | depth
         ncov = _cov_len(A, P) if self._cov else 0
+        # Sample tail sizing (obs/sample.py): [T1, T2, occupied, sdrop]
+        # plus five drained lanes of slab_entries(k) words each.
+        if self._sample_k:
+            from ..obs.sample import slab_entries
+
+            sk2 = slab_entries(self._sample_k)
+            nsamp = 4 + 5 * sk2
+            s_base = P_LEN + 2 * P + ncov
+        else:
+            sk2 = nsamp = s_base = 0
+        last_thresh = None  # threshold words last uploaded to the device
 
         depth_limit = (
             self._target_max_depth
@@ -1195,6 +1372,17 @@ class TpuBfsChecker(HostEngineBase):
             # all rows enqueue), and fills count/unique/err into the packed
             # params, which feed the first era dispatch directly.
             h1, h2 = hash_words_np(inits)
+            if self._sampler is not None:
+                # The seeder inserts init states before the era loop's
+                # slab starts capturing — offer them host-side (their
+                # rows are in hand anyway, so the sample records carry
+                # real state lanes for free).
+                self._sampler.offer_array(
+                    (h1.astype(np.uint64) << np.uint64(32))
+                    | h2.astype(np.uint64),
+                    depths=np.ones(n_init, dtype=np.int64),
+                    states=inits,
+                )
             qinit = np.zeros((W, n_init), dtype=np.uint32)
             qinit[:S] = inits.T
             qinit[S] = self._init_ebits_tensor
@@ -1206,7 +1394,12 @@ class TpuBfsChecker(HostEngineBase):
                 max_steps0 = max(
                     1, min(max_steps0, 1 + remaining // max(1, C * A))
                 )
-            template = np.zeros(P_LEN + 2 * P + ncov, dtype=np.uint32)
+            template = np.zeros(P_LEN + 2 * P + ncov + nsamp, dtype=np.uint32)
+            if self._sampler is not None:
+                t1, t2 = self._sampler.threshold_parts()
+                template[s_base] = t1
+                template[s_base + 1] = t2
+                last_thresh = (t1, t2)
             template[P_DEPTH_LIMIT] = depth_limit
             template[P_HIGH_WATER] = high_water
             template[P_MAX_STEPS] = max_steps0
@@ -1225,7 +1418,7 @@ class TpuBfsChecker(HostEngineBase):
             _dbg("run: dispatching fused seed+first-era")
             seed_run = _build_seed_loop(
                 tm, self._tprops, C, self._qcap, self._tcap, self._canon,
-                self._cov,
+                self._cov, sample_k=self._sample_k,
             )
             self._era_t0 = time.monotonic()
             table, queue, rec_fp1, rec_fp2, params_dev = seed_run(
@@ -1259,7 +1452,7 @@ class TpuBfsChecker(HostEngineBase):
             already advanced — unless that era is a no-op, which the
             caller cannot know yet)."""
             nonlocal head, count, take_cap, rec_bits, stop, params_dev
-            nonlocal budget, budget_cap
+            nonlocal budget, budget_cap, last_thresh
             with self._metrics.phase("readback"):
                 vals = np.asarray(params_dev)  # the ONE download per block
             era_dt = 0.0
@@ -1352,7 +1545,33 @@ class TpuBfsChecker(HostEngineBase):
                     cov_acc.record_property_hit(
                         p.name, int(vals[base + A + i])
                     )
-                cov_acc.record_depth_counts(vals[base + A + P + 1 :])
+                cov_acc.record_depth_counts(
+                    vals[base + A + P + 1 : base + ncov]
+                )
+
+            if self._sampler is not None:
+                # Sample-slab drain: same download as everything else.
+                occupied = int(vals[s_base + 2])
+                sdrop = int(vals[s_base + 3])
+                off = s_base + 4
+                if occupied or sdrop:
+                    self._sampler.drain_slab(
+                        vals[off : off + sk2],
+                        vals[off + sk2 : off + 2 * sk2],
+                        vals[off + 2 * sk2 : off + 3 * sk2],
+                        vals[off + 4 * sk2 : off + 5 * sk2],
+                        occupied,
+                        dropped=sdrop,
+                        actions=vals[off + 3 * sk2 : off + 4 * sk2],
+                    )
+                if self._sampler.threshold_parts() != last_thresh:
+                    # The drain tightened the threshold: force a fresh
+                    # params upload next era so the device stops
+                    # capturing (sound either way — a stale threshold
+                    # only admits a superset — but a tighter one keeps
+                    # eras long and the slab quiet). Converges fast:
+                    # expected total captures are ~k * ln(n / k).
+                    params_dev = None
 
             # Spill if the next chunk could overflow the ring. Drain to the
             # MARGIN below the watermark, not just to it: draining only the
@@ -1535,7 +1754,12 @@ class TpuBfsChecker(HostEngineBase):
                 host_dirty = True
 
             if host_dirty:
-                arr = np.zeros(P_LEN + 2 * P + ncov, dtype=np.uint32)
+                arr = np.zeros(P_LEN + 2 * P + ncov + nsamp, dtype=np.uint32)
+                if self._sampler is not None:
+                    t1, t2 = self._sampler.threshold_parts()
+                    arr[s_base] = t1
+                    arr[s_base + 1] = t2
+                    last_thresh = (t1, t2)
                 arr[:P_LEN] = [
                     head,
                     count,
@@ -1708,6 +1932,7 @@ class TpuBfsChecker(HostEngineBase):
             if params_dev is not None:
                 led.attach("packed_params", params_dev)
                 led.attach("coverage_slab", params_dev)
+                led.attach("sample_slab", params_dev)
         return
 
     def _mem_register(self, table, queue, rec_fps, params_dev) -> None:
@@ -1732,6 +1957,7 @@ class TpuBfsChecker(HostEngineBase):
             queue_capacity=self._qcap,
             table_capacity=self._tcap,
             coverage=self._cov,
+            sample_k=self._sample_k,
         )
         rec.register_components(
             sizes,
@@ -1741,6 +1967,7 @@ class TpuBfsChecker(HostEngineBase):
                 "record_fps": rec_fps,
                 "packed_params": params_dev,
                 "coverage_slab": params_dev,
+                "sample_slab": params_dev,
             },
         )
         rec.set_geometry(
@@ -1843,6 +2070,11 @@ class TpuBfsChecker(HostEngineBase):
             chunk=self._chunk,
             max_probes=vs.MAX_PROBES,
             discovery_fps={k: str(v) for k, v in self._discovery_fps.items()},
+            sampler=(
+                self._sampler.export_state()
+                if self._sampler is not None
+                else None
+            ),
         )
         arrays = {
             "rec_fp1": np.asarray(rec_fp1),
@@ -1895,6 +2127,10 @@ class TpuBfsChecker(HostEngineBase):
         self._discovery_fps = {
             k: int(v) for k, v in meta["discovery_fps"].items()
         }
+        if self._sampler is not None and meta.get("sampler"):
+            # Restore the sampler's kept set + threshold: a resumed run's
+            # sample must be identical to an uninterrupted one.
+            self._sampler.restore_state(meta["sampler"])
         self._spill = [
             data[k] for k in sorted(
                 (k for k in data if k.startswith("spill")),
@@ -1931,6 +2167,12 @@ class TpuBfsChecker(HostEngineBase):
             name: self._reconstruct(fp)
             for name, fp in list(self._discovery_fps.items())
         }
+
+    def _sample_resolver(self):
+        # Device slabs drain fingerprint-only; sample rows are resolved
+        # lazily at profile build by the same table-parent walk that
+        # reconstructs counterexample paths.
+        return self._path_sample_resolver(self._reconstruct)
 
     def _reconstruct(self, fp64: int) -> Path:
         """Walk table parent pointers, then re-execute the model along the

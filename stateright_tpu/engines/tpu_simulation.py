@@ -74,8 +74,9 @@ P_LEN = 11
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
-def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
-    key = (id(tm), B, L, len(props), cov)
+def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True,
+                    sample_k: int = 0):
+    key = (id(tm), B, L, len(props), cov, sample_k)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]  # (loop, seed_run, n_init)
@@ -92,6 +93,20 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
     S = tm.state_width
     A = tm.max_actions
     P = len(props)
+    if sample_k:
+        # Bottom-k space sampling (obs/sample.py): counted states below
+        # the host threshold append to an in-carry slab drained in the
+        # params tail (same protocol as tpu_bfs, with two differences:
+        # the capture width is the full walk batch B — so per-step drops
+        # are impossible even under a loose threshold — and the slab
+        # carries the S state lanes, since walks revisit states and no
+        # visited table exists to reconstruct rows from later).
+        from ..obs.sample import slab_entries, slab_high_water
+
+        sk2 = slab_entries(sample_k)
+        s_high = slab_high_water(sample_k)
+        scap = s_high + B  # one more step always fits
+        s_base = P_LEN + 2 * P + ((A + P + DEPTH_CAP) if cov else 0)
 
     init_np = np.asarray(tm.init_states_array(), dtype=np.uint32)
     # Boundary-filter init states at build time (host-side, static) so the
@@ -141,17 +156,30 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
         fin_all_en = params[P_FIN_ALL_EN]
         target_gen = params[P_TARGET_GEN]
         gen0 = params[P_GEN0]
+        if sample_k:
+            # Sampling threshold words (pass-through; stale = looser =
+            # superset, host re-filters).
+            st1 = params[s_base]
+            st2 = params[s_base + 1]
         iota_b = jnp.arange(B, dtype=u)
         iota_l = jnp.arange(L, dtype=u)
         inits = tuple(jnp.asarray(l) for l in init_lanes_const)
 
         def cond(carry):
-            (_w, _f1, _f2, gen, steps, rec_acc, _h, _pl, maxd, _covc) = carry
+            (
+                _w, _f1, _f2, gen, steps, rec_acc, _h, _pl, maxd, _covc,
+                sampc,
+            ) = carry
             fin_hit = ((rec_acc & fin_any) != u(0)) | (
                 (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
             )
             under_target = (target_gen == u(0)) | (gen0 + gen < target_gen)
-            return (steps < max_steps) & ~fin_hit & under_target
+            keep = (steps < max_steps) & ~fin_hit & under_target
+            if sample_k:
+                # Slab-occupancy gate (uint32 sum chain — carry-safe):
+                # exit so the host drains before the slab can overflow.
+                keep = keep & (sampc[3] <= u(s_high))
+            return keep
 
         def body(carry):
             (
@@ -165,6 +193,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
                 plen,
                 maxd,
                 covc,
+                sampc,
             ) = carry
             active = ~frozen
             h1, h2 = hash_lanes_jnp(rows)
@@ -187,6 +216,41 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
             counted = active & ~cycle
             ptr = jnp.where(counted, ptr + u(1), ptr)
             gen = gen + counted.sum(dtype=u)
+            if sample_k:
+                # Sample capture: counted states lexicographically below
+                # the threshold, with their state rows in hand (walks
+                # revisit states across traces — the host sampler dedups
+                # by fingerprint, so the sample stays a pure function of
+                # the visited set). Full-B capture width: no drops, ever.
+                from ..ops.visited_set import _compact_ids
+
+                below = counted & (
+                    (h1 < st1) | ((h1 == st1) & (h2 < st2))
+                )
+
+                def _capture(sc):
+                    sfp1, sfp2, sdep, socc, sst = sc
+                    cids, cvalid, n_c = _compact_ids(below, B)
+                    pos = socc + iota_b
+                    ok_w = cvalid & (pos < u(scap))
+                    widx = jnp.where(ok_w, pos, u(scap))
+                    return (
+                        sfp1.at[widx].set(h1[cids]),
+                        sfp2.at[widx].set(h2[cids]),
+                        sdep.at[widx].set(ptr[cids]),
+                        socc + n_c,
+                        tuple(
+                            sst[s].at[widx].set(rows[s][cids])
+                            for s in range(S)
+                        ),
+                    )
+
+                # Tight-threshold steps capture nothing almost always;
+                # the cond skips the compaction and the (3+S)-lane slab
+                # scatter on those steps.
+                sampc = lax.cond(
+                    below.any(), _capture, lambda sc: sc, sampc
+                )
             if cov:
                 # Depth histogram: each counted state lands at its walk
                 # depth (the just-incremented ptr; clamped into the
@@ -323,6 +387,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
                 plen,
                 maxd,
                 covc,
+                sampc,
             )
 
         rows, seed, ptr, ebits = walk[:S], walk[S], walk[S + 1], walk[S + 2]
@@ -350,6 +415,18 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
             if cov
             else ()
         )
+        sampc0 = (
+            (
+                # scap+1 wide: index scap is the masked-write trash slot.
+                jnp.zeros(scap + 1, dtype=u),  # fp1
+                jnp.zeros(scap + 1, dtype=u),  # fp2
+                jnp.zeros(scap + 1, dtype=u),  # depth (walk position)
+                zero_b[0],  # occupied
+                tuple(jnp.zeros(scap + 1, dtype=u) for _ in range(S)),
+            )
+            if sample_k
+            else ()
+        )
         init_carry = (
             (tuple(rows), seed, ptr, ebits, false_b),
             fp1buf,
@@ -361,6 +438,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
             tuple(zero_b for _ in range(P)),
             zero_b,
             covc0,
+            sampc0,
         )
         (
             (rows, seed, ptr, ebits, frozen),
@@ -373,6 +451,7 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
             plen,
             maxd,
             covc_out,
+            sampc_out,
         ) = lax.while_loop(cond, body, init_carry)
 
         # Epilogue: per newly-hit property, report the SHORTEST hit's walk
@@ -420,6 +499,36 @@ def _build_sim_loop(tm: TensorModel, props, B: int, L: int, cov: bool = True):
                 jnp.stack(list(covp)) if P else jnp.zeros(0, dtype=u),
                 dhist,
             ]
+        if sample_k:
+            # Sample tail: [T1, T2, occupied, sdrop=0] + the sk2 smallest
+            # slab entries by h1 (fp1 | fp2 | depth | S state lanes | ok)
+            # — one top_k in the once-per-era epilogue. The ok lane
+            # disambiguates padding from a real fp1 of 0xFFFFFFFF.
+            # Walks revisit states, so the slab holds duplicate fps and a
+            # plain top_k would spend all sk2 lanes on copies of the few
+            # smallest — dedup first (first occurrence wins; O(scap^2)
+            # bool matrix, epilogue-only so it costs once per era).
+            sfp1, sfp2, sdep, socc, sst = sampc_out
+            used = jnp.arange(scap, dtype=u) < socc
+            f1, f2 = sfp1[:scap], sfp2[:scap]
+            same = (
+                (f1[:, None] == f1[None, :])
+                & (f2[:, None] == f2[None, :])
+                & used[None, :]
+            )
+            idx = jnp.arange(scap, dtype=u)
+            dup = (same & (idx[None, :] < idx[:, None])).any(axis=1)
+            used = used & ~dup
+            skey = jnp.where(used, ~sfp1[:scap], u(0))
+            _topv, topi = lax.top_k(skey, sk2)
+            parts += [
+                jnp.stack([st1, st2, socc, u(0)]),
+                sfp1[:scap][topi],
+                sfp2[:scap][topi],
+                sdep[:scap][topi],
+            ]
+            parts += [sst[s][:scap][topi] for s in range(S)]
+            parts += [used[topi].astype(u)]
         params_out = jnp.concatenate(parts)
         return walk_out, fp1buf, fp2buf, params_out
 
@@ -672,8 +781,10 @@ class TpuSimulationChecker(HostEngineBase):
         self._cov = self._coverage.enabled
         self._stage_profile = bool(getattr(builder, "stage_profile_", False))
         self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
+        self._sample_k = self._sampler.k if self._sampler is not None else 0
         self._loop, self._seed_run, self._n_init = _build_sim_loop(
-            self.tm, self._tprops, self._B, self._L, self._cov
+            self.tm, self._tprops, self._B, self._L, self._cov,
+            sample_k=self._sample_k,
         )
         self._start()
 
@@ -705,7 +816,23 @@ class TpuSimulationChecker(HostEngineBase):
 
         A = tm.max_actions
         ncov = (A + P + DEPTH_CAP) if self._cov else 0
-        params = np.zeros(P_LEN + 2 * P + ncov, dtype=np.uint32)
+        # Sample tail: [T1, T2, occupied, sdrop] + (fp1|fp2|depth|S state
+        # lanes|ok) x slab_entries(k) words.
+        if self._sample_k:
+            from ..obs.sample import slab_entries
+
+            sk2 = slab_entries(self._sample_k)
+            nsamp = 4 + (4 + S) * sk2
+            s_base = P_LEN + 2 * P + ncov
+        else:
+            sk2 = nsamp = s_base = 0
+        last_thresh = None
+        params = np.zeros(P_LEN + 2 * P + ncov + nsamp, dtype=np.uint32)
+        if self._sampler is not None:
+            t1, t2 = self._sampler.threshold_parts()
+            params[s_base] = t1
+            params[s_base + 1] = t2
+            last_thresh = (t1, t2)
         params[P_MAX_STEPS] = max_sync
         params[P_FIN_ANY] = fin_any
         params[P_FIN_ALL] = fin_all
@@ -738,12 +865,14 @@ class TpuSimulationChecker(HostEngineBase):
                             walks=B,
                             walk_cap=L,
                             coverage=self._cov,
+                            sample_k=self._sample_k,
                         ),
                         arrays={
                             "walk_lanes": walk,
                             "path_fps": (fp1buf, fp2buf),
                             "packed_params": params_dev,
                             "coverage_slab": params_dev,
+                            "sample_slab": params_dev,
                         },
                     )
             else:
@@ -775,7 +904,49 @@ class TpuSimulationChecker(HostEngineBase):
                     cov_acc.record_property_hit(
                         p.name, int(vals[base + A + i])
                     )
-                cov_acc.record_depth_counts(vals[base + A + P :])
+                cov_acc.record_depth_counts(
+                    vals[base + A + P : base + ncov]
+                )
+
+            if self._sampler is not None:
+                # Sample-slab drain (same download). Device walks revisit
+                # states, so re-drains of the same fingerprint are normal;
+                # the sampler dedups.
+                occupied = int(vals[s_base + 2])
+                off = s_base + 4
+                if occupied:
+                    srows = np.stack(
+                        [
+                            vals[
+                                off + (3 + s) * sk2 : off + (4 + s) * sk2
+                            ]
+                            for s in range(S)
+                        ],
+                        axis=1,
+                    )
+                    # exact=False: walk revisits put DUPLICATE fps in the
+                    # slab, so occupied > drained means duplicates, not
+                    # truncation — the exact tie cut would starve the sample.
+                    self._sampler.drain_slab(
+                        vals[off : off + sk2],
+                        vals[off + sk2 : off + 2 * sk2],
+                        vals[off + 2 * sk2 : off + 3 * sk2],
+                        vals[off + (3 + S) * sk2 : off + (4 + S) * sk2],
+                        occupied,
+                        states=srows,
+                        exact=False,
+                    )
+                if self._sampler.threshold_parts() != last_thresh:
+                    # Tightened threshold: re-upload the params vector
+                    # (everything else in it is the era's own pass-through
+                    # output, so a host copy with only the T words changed
+                    # is exact).
+                    arr = np.array(vals)
+                    t1, t2 = self._sampler.threshold_parts()
+                    arr[s_base] = t1
+                    arr[s_base + 1] = t2
+                    last_thresh = (t1, t2)
+                    params_dev = jnp.asarray(arr)
 
             new_bits = int(vals[P_REC])
             if new_bits != rec_bits:

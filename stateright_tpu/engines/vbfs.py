@@ -93,6 +93,12 @@ class VectorizedBfsChecker(HostEngineBase):
         is_new = self._visited.insert_batch(keys, self._nthreads)
         for k in keys[is_new]:
             self._parents[int(k)] = 0
+        if self._sampler is not None:
+            self._sampler.offer_array(
+                keys[is_new],
+                depths=np.ones(int(is_new.sum()), dtype=np.int64),
+                states=inits[is_new],
+            )
         self._coverage.record_depth(1, int(is_new.sum()))
         self._metrics.set_gauge("threads", self._nthreads)
         self._blocks = deque()
@@ -225,6 +231,12 @@ class VectorizedBfsChecker(HostEngineBase):
                     self._parents.update(
                         zip(nk.tolist(), np_par.tolist())
                     )
+                    if self._sampler is not None:
+                        self._sampler.offer_array(
+                            nk,
+                            depths=cdepth[nidx],
+                            states=crows[nidx],
+                        )
                     if cov is not None:
                         cov.record_depth_counts(
                             np.bincount(cdepth[nidx].astype(np.int64))

@@ -22,6 +22,11 @@ Reference parity: src/checker/explorer.rs. Routes:
     host_gap wall split, frontier occupancy, load factor, spill/refill
     volumes) plus the run-level summary — feeding the dashboard's
     flight timeline panel;
+  - ``GET /space`` (alias ``/.space``) — the run's space profile
+    (obs/sample.py): the deterministic bottom-k state sample rendered
+    into per-field value sketches, depth/action exemplars, packing
+    saturation warnings, and the KMV state-count estimate — feeding
+    the dashboard's space panel;
   - ``GET /memory`` (alias ``/.memory``) — the run's memory-ledger
     snapshot (obs/memory.py): per-component device residency with
     shapes/dtypes, growth events, live headroom, the forecaster's
@@ -316,6 +321,19 @@ def _flight_view(checker: Checker) -> Dict:
     }
 
 
+def _space_view(checker: Checker) -> Dict:
+    """GET /space: the run's space profile (obs/sample.py) — the
+    deterministic bottom-k sample's per-field sketches, depth exemplars,
+    action exemplars, saturation warnings, and the KMV cardinality
+    estimate — timestamped like /metrics so the dashboard can poll it.
+    Runs with sampling disabled serve an empty ``space`` object."""
+    return {
+        "ts": time.time(),
+        "done": checker.is_done(),
+        "space": checker.space_profile() or {},
+    }
+
+
 def _memory_view(checker: Checker) -> Dict:
     """GET /memory: the run's memory-ledger snapshot (obs/memory.py) —
     per-component residency, growth events, the forecaster's projection,
@@ -519,6 +537,8 @@ class ExplorerServer:
                     self._send_json(_flight_view(explorer.checker))
                 elif path in ("/memory", "/.memory"):
                     self._send_json(_memory_view(explorer.checker))
+                elif path in ("/space", "/.space"):
+                    self._send_json(_space_view(explorer.checker))
                 elif path in ("/events", "/.events"):
                     self._serve_sse(
                         explorer.spans,

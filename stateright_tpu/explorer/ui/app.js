@@ -447,6 +447,84 @@ async function pollMemory() {
   setTimeout(pollMemory, 2000);
 }
 
+// ---- space panel -----------------------------------------------------------
+// Polls /space every 2s: the deterministic bottom-k sample's per-field
+// value sketches as rows (obs/sample.py), a sample-size / KMV-estimate
+// readout, and packing-saturation warnings as a banner.
+
+function sketchSummary(sk) {
+  if (sk.kind === "bool") return `true ${sk.true} · false ${sk.false}`;
+  if (sk.kind === "int")
+    return sk.min === sk.max
+      ? `= ${sk.min}`
+      : `${sk.min} … ${sk.max} · ${sk.distinct} distinct`;
+  return `${sk.distinct} distinct`;
+}
+
+function renderSpaceFields(fields) {
+  const holder = $("space-fields");
+  holder.innerHTML = "";
+  const entries = Object.entries(fields);
+  const max = Math.max(...entries.map(([, sk]) => sk.distinct), 1);
+  for (const [label, sk] of entries) {
+    const row = document.createElement("div");
+    row.className = "cov-row";
+    const name = document.createElement("span");
+    name.className = "cov-label";
+    name.textContent = label;
+    name.title = `${sk.kind} · ${sk.count} sampled`;
+    const track = document.createElement("span");
+    track.className = "cov-track";
+    const bar = document.createElement("span");
+    bar.className = "cov-bar";
+    bar.style.width = Math.max(1, (sk.distinct / max) * 100).toFixed(1) + "%";
+    track.appendChild(bar);
+    const val = document.createElement("span");
+    val.className = "cov-count";
+    val.textContent = sketchSummary(sk);
+    row.appendChild(name);
+    row.appendChild(track);
+    row.appendChild(val);
+    holder.appendChild(row);
+  }
+}
+
+async function pollSpace() {
+  try {
+    const res = await fetch("/space");
+    const body = await res.json();
+    const space = body.space || {};
+    if (space.samples) {
+      $("space-panel").hidden = false;
+      renderSpaceFields(space.fields || {});
+      const bits = [
+        `sample ${space.samples}/${space.k}`,
+        `~${Number(space.est_states).toLocaleString()} states (KMV)`,
+      ];
+      if (space.unresolved) bits.push(`${space.unresolved} unresolved`);
+      if (space.degraded) bits.push("degraded");
+      const depths = Object.keys(space.depths || {});
+      if (depths.length) bits.push(`depths ${depths.length}`);
+      $("space-readout").textContent = bits.join(" · ");
+      const warnEl = $("space-warning");
+      const sat = space.saturated || [];
+      if (sat.length) {
+        warnEl.hidden = false;
+        warnEl.textContent =
+          "⚠ packing saturation: " +
+          sat
+            .map((s) => `${s.field || "lane " + s.lane} at ${s.bits}-bit`)
+            .join(", ");
+      } else {
+        warnEl.hidden = true;
+      }
+    }
+  } catch (e) {
+    /* space endpoint unavailable: leave the panel hidden */
+  }
+  setTimeout(pollSpace, 2000);
+}
+
 // ---- span waterfall (run ledger) -------------------------------------------
 // Span completions arrive live over GET /events (SSE, obs/spans.py). The
 // waterfall draws the most recent trace's spans as horizontal bars on a
@@ -656,5 +734,6 @@ pollMetrics();
 pollCoverage();
 pollFlight();
 pollMemory();
+pollSpace();
 startSpanStream();
 loadStates();

@@ -18,6 +18,12 @@ Prometheus text exposition format (the Explorer serves it at
 counters, populated from the packed-params readback the device engines
 already do (`Checker.flight()`; `CheckerBuilder.flight()` configures it).
 
+`obs/sample.py` adds the space profiler: deterministic bottom-k
+fingerprint sampling of the explored state space (identical sample set
+across engines/shards/pipelining), rendered into per-field distribution
+sketches, depth/action exemplars, and a packing-saturation detector
+(`Checker.space_profile()`; `CheckerBuilder.sample()` configures it).
+
 See `stateright_tpu/obs/README.md` for the consolidated metric-name
 catalog, `obs/coverage.py` for coverage-count semantics, and
 `obs/trace.py` for the trace event schema.
@@ -34,6 +40,18 @@ from .memory import (
     format_plan,
     plan,
     recommend_engine,
+)
+from .sample import (
+    DEFAULT_SAMPLE_K,
+    DEVICE_STEP_CAP,
+    NO_ACTION,
+    SLAB_PAD,
+    SpaceSampler,
+    build_space_profile,
+    detect_saturation,
+    slab_capacity,
+    slab_entries,
+    slab_high_water,
 )
 from .metrics import (
     MEMORY_SERIES_LABELS,
@@ -54,9 +72,19 @@ from .trace import (
 
 __all__ = [
     "DEFAULT_FLIGHT_CAPACITY",
+    "DEFAULT_SAMPLE_K",
     "DEPTH_CAP",
+    "DEVICE_STEP_CAP",
+    "NO_ACTION",
+    "SLAB_PAD",
     "ChromeTraceWriter",
     "Coverage",
+    "SpaceSampler",
+    "build_space_profile",
+    "detect_saturation",
+    "slab_capacity",
+    "slab_entries",
+    "slab_high_water",
     "FlightRecorder",
     "Forecaster",
     "Histogram",

@@ -137,14 +137,18 @@ def bfs_component_sizes(
     queue_capacity: int = 1 << 20,
     table_capacity: int = 1 << 22,
     coverage: bool = True,
+    sample_k: int = 64,
 ) -> Dict[str, Dict[str, Any]]:
     """Device buffers of the solo BFS engine (engines/tpu_bfs.py).
 
     The visited table is (keys[2t] | parent_h1[t] | parent_h2[t]) = 4t
     words; the frontier ring is W = S+2 lanes (state | ebits | depth);
     the packed params vector carries P_LEN counters + 2P recorded
-    fingerprint halves + the coverage tail (one buffer — the coverage
-    slab is carved out analytically but shares the params allocation).
+    fingerprint halves + the coverage tail + the sample tail (one
+    buffer — the coverage and sample slabs are carved out analytically
+    but share the params allocation). The sample tail is
+    [T1, T2, occupied, sdrop] + (fp1|fp2|depth|action|ok) x
+    slab_entries(k) words (``sample_k=0`` = sampling off).
     """
     from ..engines.tpu_bfs import P_LEN, _cov_len
 
@@ -160,6 +164,10 @@ def bfs_component_sizes(
     }
     if coverage:
         sizes["coverage_slab"] = _entry((ncov,))
+    if sample_k:
+        from .sample import slab_entries
+
+        sizes["sample_slab"] = _entry((4 + 5 * slab_entries(int(sample_k)),))
     return sizes
 
 
@@ -172,13 +180,18 @@ def sim_component_sizes(
     walk_cap: int = 256,
     target_max_depth: Optional[int] = None,
     coverage: bool = True,
+    sample_k: int = 64,
 ) -> Dict[str, Dict[str, Any]]:
     """Device buffers of the simulation engine (engines/tpu_simulation.py).
 
     The walk block is S+4 lanes (state | seed | ptr | ebits | frozen) x B
     walks; the path-fingerprint ring is B*L per hash half (L clamps to
     the depth target); params is P_LEN + 2P + (A + P + DEPTH_CAP)
-    coverage words. Static footprint — no growth, no spill.
+    coverage words + the sample tail — [T1, T2, occupied, sdrop] +
+    (fp1|fp2|depth|S state lanes|ok) x slab_entries(k) words (the walk
+    slab carries full state rows: walks revisit states and there is no
+    visited table to reconstruct them from later). Static footprint —
+    no growth, no spill.
     """
     from ..engines.tpu_simulation import P_LEN
     from .coverage import DEPTH_CAP
@@ -196,6 +209,12 @@ def sim_component_sizes(
     }
     if coverage:
         sizes["coverage_slab"] = _entry((int(A) + int(P) + DEPTH_CAP,))
+    if sample_k:
+        from .sample import slab_entries
+
+        sizes["sample_slab"] = _entry(
+            (4 + (4 + int(S)) * slab_entries(int(sample_k)),)
+        )
     return sizes
 
 
@@ -209,13 +228,16 @@ def mesh_component_sizes(
     table_capacity_per_shard: int = 1 << 18,
     n_shards: int = 8,
     coverage: bool = True,
+    sample_k: int = 64,
 ) -> Dict[str, Dict[str, Any]]:
     """Device buffers of the sharded mesh engine (parallel/mesh.py).
 
     Every component carries the shard dimension N: per-shard visited
     tables (keys[N,2t] | p1[N,t] | p2[N,t]), the W = S+2 queue lanes at
     [N, qcap] each, and the per-shard packed params rows (counters + a
-    coverage tail of A + P + 1 + DEPTH_CAP words, psum'd on device).
+    coverage tail of A + P + 1 + DEPTH_CAP words, psum'd on device, +
+    per-shard sample tails of 4 + 4*slab_entries(k) words — fp1|fp2|
+    depth|ok, un-reduced: the host unions the per-shard bottom-k).
     """
     from .coverage import DEPTH_CAP
 
@@ -232,6 +254,12 @@ def mesh_component_sizes(
     }
     if coverage:
         sizes["coverage_slab"] = _entry((N, ncov))
+    if sample_k:
+        from .sample import slab_entries
+
+        sizes["sample_slab"] = _entry(
+            (N, 4 + 4 * slab_entries(int(sample_k)))
+        )
     return sizes
 
 
@@ -803,6 +831,7 @@ def plan(
     init_capacity: Optional[int] = None,
     n_shards: Optional[int] = None,
     coverage: bool = True,
+    sample_k: int = 64,
     device_limit_bytes=_UNSET,
 ) -> Dict[str, Any]:
     """Predict the full device footprint for ``model`` on ``engine``
@@ -836,13 +865,17 @@ def plan(
                 table_capacity if table_capacity is not None else 1 << 22
             ),
         }
-        sizes = bfs_component_sizes(S, A, P, coverage=coverage, **geometry)
+        sizes = bfs_component_sizes(
+            S, A, P, coverage=coverage, sample_k=sample_k, **geometry
+        )
     elif kind == "tpu_simulation":
         geometry = {
             "walks": walks if walks is not None else 1024,
             "walk_cap": walk_cap if walk_cap is not None else 256,
         }
-        sizes = sim_component_sizes(S, A, P, coverage=coverage, **geometry)
+        sizes = sim_component_sizes(
+            S, A, P, coverage=coverage, sample_k=sample_k, **geometry
+        )
     elif kind == "sharded":
         geometry = {
             "chunk": chunk if chunk is not None else 1024,
@@ -854,7 +887,9 @@ def plan(
             ),
             "n_shards": n_shards if n_shards is not None else 8,
         }
-        sizes = mesh_component_sizes(S, A, P, coverage=coverage, **geometry)
+        sizes = mesh_component_sizes(
+            S, A, P, coverage=coverage, sample_k=sample_k, **geometry
+        )
     else:  # multiplex
         geometry = {
             "lanes": lanes if lanes is not None else 32,
@@ -876,6 +911,7 @@ def plan(
         "max_actions": A,
         "properties": P,
         "coverage": bool(coverage),
+        "sample_k": int(sample_k) if kind != "multiplex" else 0,
         "geometry": geometry,
         "components": sizes,
         "total_bytes": total,
@@ -1016,6 +1052,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--no-coverage", action="store_true", help="plan without coverage slabs"
     )
     parser.add_argument(
+        "--sample-k",
+        type=int,
+        default=64,
+        help="bottom-k sample size the run will use (0 = sampling off; "
+        "default matches CheckerBuilder.sample())",
+    )
+    parser.add_argument(
         "--limit-bytes",
         type=int,
         default=None,
@@ -1037,6 +1080,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         lanes=args.lanes,
         n_shards=args.shards,
         coverage=not args.no_coverage,
+        sample_k=max(0, args.sample_k),
     )
     if args.limit_bytes is not None:
         kw["device_limit_bytes"] = args.limit_bytes

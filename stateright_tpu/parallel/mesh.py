@@ -76,9 +76,10 @@ _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
 def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
-                 quota: int, mesh, axis: str, cov: bool = True):
+                 quota: int, mesh, axis: str, cov: bool = True,
+                 sample_k: int = 0):
     key = (
-        id(tm), chunk, qcap, n_shards, quota, len(props), cov,
+        id(tm), chunk, qcap, n_shards, quota, len(props), cov, sample_k,
         tuple(id(d) for d in mesh.devices.flat),
     )
     cached = _LOOP_CACHE.get(key)
@@ -115,6 +116,24 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
     # duplicate would cross the ICI to its owner before losing the claim
     # there. Approximate as ever; the owner's insert arbitrates exactly.
     dedup_cap = 1 << max(1, (2 * vcap - 1).bit_length())
+    # Space-sampling slab (obs/sample.py): each SHARD keeps its own
+    # fixed slab of candidate fingerprints below the host's bottom-k
+    # threshold, captured at the owner-side insert (is_new is exactly-once
+    # globally, so slab entries are distinct fps and the host's exact h1
+    # tie cut applies). Captures happen at the exchange receive width R,
+    # never truncated — slab capacity s_high + R plus the psum'd
+    # occupancy gate guarantee every below-threshold insert is captured,
+    # so per-(shard, era) drains of the sk2 smallest merge into the exact
+    # global bottom-k by trivial union (PSUM-FREE: the tails ride the
+    # per-shard params rows un-reduced).
+    R = n_shards * quota
+    if sample_k:
+        from ..obs.sample import slab_entries, slab_high_water
+
+        sk2 = slab_entries(sample_k)
+        s_high = slab_high_water(sample_k)
+        scap = s_high + R  # next step's captures (<= R) always fit
+    s_base = P_LEN + ((A + NP_ + 1 + DEPTH_CAP) if cov else 0)
 
     def per_device(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
@@ -134,8 +153,13 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
         fin_all = params[P_FIN_ALL]
         fin_all_en = params[P_FIN_ALL_EN]
         budget_cap = params[P_BUDGET_CAP]
+        if sample_k:
+            # Host bottom-k threshold (exclusive; uint32 halves). Stale
+            # (looser) thresholds only over-capture — always sound.
+            st1 = params[s_base]
+            st2 = params[s_base + 1]
 
-        def global_gates(count, unique, err_cnt, hseen, rec_acc0, its):
+        def global_gates(count, unique, err_cnt, hseen, rec_acc0, its, socc):
             """One stacked psum produces every exit condition, IDENTICAL on
             all shards (the while predicate must be uniform): work left,
             congestion (a shard cannot refuse all_to_all deliveries, so no
@@ -149,6 +173,11 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             ] + [
                 jnp.minimum(hseen[pi].sum(dtype=u), u(1)) for pi in range(NP_)
             ]
+            if sample_k:
+                # Sampling-slab occupancy: when ANY shard's slab passes its
+                # high-water mark the era ends so the host can drain it
+                # (appended LAST so the established g[] indices hold).
+                local.append((socc > u(s_high)).astype(u))
             g = lax.psum(jnp.stack(local), axis)
             rec_acc = rec_acc0
             for pi in range(NP_):
@@ -164,8 +193,10 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 & (g[2] == u(0))
                 & ~fin_hit
                 & (its < max_steps)
-            ).astype(u)
-            return g_cont
+            )
+            if sample_k:
+                g_cont = g_cont & (g[3 + NP_] == u(0))
+            return g_cont.astype(u)
 
         def cond(carry):
             return carry[-1] != u(0)  # carried uniform gate
@@ -186,6 +217,7 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                 facc2,
                 faccd,
                 covc,
+                sampc,
                 its,
                 _g_cont,
             ) = carry
@@ -267,6 +299,38 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             unres = unresolved.sum(dtype=u)
             new_count = is_new.sum(dtype=u)
 
+            if sample_k:
+                # Capture below-threshold inserts into this shard's slab.
+                # `is_new` is exactly-once (retried partial-commit steps
+                # re-deliver already-inserted rows, which are not new), so
+                # no fingerprint is ever captured twice. Writes happen at
+                # the full receive width R — never truncated; the trash
+                # slot at index scap absorbs masked lanes.
+                below = is_new & (
+                    (rh1 < st1) | ((rh1 == st1) & (rh2 < st2))
+                )
+
+                def _capture(sc):
+                    sfp1, sfp2, sdep, socc = sc
+                    cids, cvalid, n_c = vs._compact_ids(below, R)
+                    pos = socc + jnp.arange(R, dtype=u)
+                    ok_w = cvalid & (pos < u(scap))
+                    widx = jnp.where(ok_w, pos, u(scap))
+                    return (
+                        sfp1.at[widx].set(rh1[cids]),
+                        sfp2.at[widx].set(rh2[cids]),
+                        sdep.at[widx].set(recv[S + 3][cids]),
+                        socc + n_c,
+                    )
+
+                # Tight-threshold steps capture nothing almost always;
+                # the cond skips the compaction and slab scatters then.
+                # Per-shard predicate — shards diverge, which is fine:
+                # nothing inside the branch communicates.
+                sampc = lax.cond(
+                    below.any(), _capture, lambda sc: sc, sampc
+                )
+
             qrows = rstates + (recv[S + 2], recv[S + 3])
             tail = (head + count) & u(qmask)
             queue = fr.ring_scatter(queue, tail, qrows, is_new)
@@ -338,10 +402,14 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                     covc = (covc[0], tuple(covp_n), covc[2], covc[3])
 
             its = its + u(1)
-            g_cont = global_gates(count, unique, err_cnt, hseen, rec_bits, its)
+            g_cont = global_gates(
+                count, unique, err_cnt, hseen, rec_bits, its,
+                sampc[3] if sample_k else its,
+            )
             return (
                 table, queue, head, count, unique, gen, steps, err_cnt,
-                take_cap, hseen, facc1, facc2, faccd, covc, its, g_cont,
+                take_cap, hseen, facc1, facc2, faccd, covc, sampc, its,
+                g_cont,
             )
 
         zero_lane = jnp.zeros(chunk, dtype=u) + (params[0] & u(0))
@@ -360,6 +428,17 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             tuple(false_lane for _ in range(NP_)),
             rec_bits,
             vzero,
+            vzero,  # slab starts empty every era
+        )
+        sampc0 = (
+            (
+                jnp.zeros(scap + 1, dtype=u) + vzero,  # fp1 (+ trash slot)
+                jnp.zeros(scap + 1, dtype=u) + vzero,  # fp2
+                jnp.zeros(scap + 1, dtype=u) + vzero,  # depth
+                vzero,  # occupied
+            )
+            if sample_k
+            else ()
         )
         covc0 = (
             (
@@ -386,12 +465,14 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
             tuple(zero_lane for _ in range(NP_)),
             tuple(zero_lane for _ in range(NP_)),
             covc0,
+            sampc0,
             vzero,  # iteration counter (uniform: every shard runs lockstep)
             g0,
         )
         (
             table, queue, head, count, unique, gen, steps, err_cnt,
-            take_cap_out, hseen, facc1, facc2, faccd, covc_out, its_out, _gc,
+            take_cap_out, hseen, facc1, facc2, faccd, covc_out, sampc_out,
+            its_out, _gc,
         ) = lax.while_loop(cond, body, init)
 
         # Block epilogue (once per block): BLOCK-LOCAL discovery reports.
@@ -491,6 +572,24 @@ def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                     axis,
                 )
             )
+        if sample_k:
+            # Per-shard sample tail, deliberately UN-psum'd (fingerprints
+            # don't reduce): [T1, T2, occupied, 0] + the sk2 smallest slab
+            # entries by h1 (fp1 | fp2 | depth | ok). One top_k in the
+            # once-per-era epilogue; the ok lane disambiguates padding
+            # from a real fp1 of 0xFFFFFFFF; the host applies the exact
+            # 64-bit tie cut (obs/sample.py) and unions the shards.
+            sfp1, sfp2, sdep, socc = sampc_out
+            used = jnp.arange(scap, dtype=u) < socc
+            skey = jnp.where(used, ~sfp1[:scap], u(0))
+            _topv, topi = lax.top_k(skey, sk2)
+            parts += [
+                jnp.stack([st1, st2, socc, vzero]),
+                sfp1[:scap][topi],
+                sfp2[:scap][topi],
+                sdep[:scap][topi],
+                used[topi].astype(u),
+            ]
         params_out = jnp.concatenate(parts)
 
         def exp(x):
@@ -872,6 +971,7 @@ class ShardedBfsChecker(HostEngineBase):
                 f"{self._qcap}. Raise the queue capacity or lower chunk_size."
             )
         self._cov = self._coverage.enabled
+        self._sample_k = self._sampler.k if self._sampler is not None else 0
         self._stage_profile = bool(getattr(builder, "stage_profile_", False))
         self._stage_iters = int(getattr(builder, "stage_profile_iters_", 32))
         # Speculative era pipelining (CheckerBuilder.pipeline(), default
@@ -881,6 +981,7 @@ class ShardedBfsChecker(HostEngineBase):
         self._block = _build_block(
             self.tm, self._tprops, self._chunk, self._qcap, self.n_shards,
             self._quota, self.mesh, "shards", self._cov,
+            sample_k=self._sample_k,
         )
 
         self._unique = 0
@@ -996,6 +1097,17 @@ class ShardedBfsChecker(HostEngineBase):
                 self._host_insert(table_np[o], int(h1[i]), int(h2[i]))
                 self._unique += 1
         self._coverage.record_depth(1, len(seen))
+        if self._sampler is not None:
+            # Init states never pass the device slab (they are host-seeded,
+            # not exchanged) — offer them here; the sampler dedups.
+            fps = (h1.astype(np.uint64) << np.uint64(32)) | h2.astype(
+                np.uint64
+            )
+            self._sampler.offer_array(
+                fps,
+                depths=np.ones(len(inits), dtype=np.int64),
+                states=inits,
+            )
 
         # Pack the host-seeded 4-lane rows into the device table layout:
         # per-shard key buffer [2*tcap] (h1 half | h2 half) + parent lanes.
@@ -1051,6 +1163,7 @@ class ShardedBfsChecker(HostEngineBase):
             table_capacity_per_shard=self._tcap,
             n_shards=self.n_shards,
             coverage=self._cov,
+            sample_k=self._sample_k,
         )
         rec.register_components(
             sizes,
@@ -1060,6 +1173,7 @@ class ShardedBfsChecker(HostEngineBase):
                 "record_fps": rec_fps,
                 "packed_params": params_dev,
                 "coverage_slab": params_dev,
+                "sample_slab": params_dev,
             },
         )
         rec.set_geometry(
@@ -1095,6 +1209,14 @@ class ShardedBfsChecker(HostEngineBase):
         N = self.n_shards
         NP_ = len(self._tprops)
         ncov = (A + NP_ + 1 + DEPTH_CAP) if self._cov else 0
+        sk2 = nsamp = 0
+        if self._sample_k:
+            from ..obs.sample import slab_entries
+
+            sk2 = slab_entries(self._sample_k)
+            nsamp = 4 + 4 * sk2  # [T1,T2,occupied,0] + fp1|fp2|dep|ok
+        s_base = P_LEN + ncov
+        last_thresh = None
         max_sync = (
             self._max_sync_steps
             if self._timeout is None and self._ckpt_every is None
@@ -1246,7 +1368,27 @@ class ShardedBfsChecker(HostEngineBase):
                     cov_acc.record_property_hit(
                         p.name, int(cov_row[base + A + pi])
                     )
-                cov_acc.record_depth_counts(cov_row[base + A + NP_ + 1 :])
+                cov_acc.record_depth_counts(
+                    cov_row[base + A + NP_ + 1 : base + ncov]
+                )
+
+            if self._sampler is not None:
+                # Drain every shard's sample tail (un-psum'd, per-shard
+                # rows): the global bottom-k is the trivial union of the
+                # per-shard drains — the sampler's offer dedups and keeps
+                # the k smallest.
+                for s in range(N):
+                    row = vals[s]
+                    occupied = int(row[s_base + 2])
+                    if occupied:
+                        off = s_base + 4
+                        self._sampler.drain_slab(
+                            row[off : off + sk2],
+                            row[off + sk2 : off + 2 * sk2],
+                            row[off + 2 * sk2 : off + 3 * sk2],
+                            row[off + 3 * sk2 : off + 4 * sk2],
+                            occupied,
+                        )
 
             block_bits = int(np.bitwise_or.reduce(vals[:, P_REC]))
             if block_bits:
@@ -1483,7 +1625,7 @@ class ShardedBfsChecker(HostEngineBase):
                     1, min(max_steps, 1 + remaining // max(1, N * C * A))
                 )
 
-            params_np = np.zeros((N, P_LEN + ncov), dtype=np.uint32)
+            params_np = np.zeros((N, P_LEN + ncov + nsamp), dtype=np.uint32)
             for s in range(N):
                 params_np[s, :P_LEN] = [
                     heads[s], counts[s], per_shard_unique[s], rec_bits,
@@ -1491,6 +1633,11 @@ class ShardedBfsChecker(HostEngineBase):
                     0, 0, 0, 0, take_caps[s],
                     fin_any, fin_all, fin_all_en, budget_cap,
                 ]
+            if self._sample_k:
+                t1, t2 = self._sampler.threshold_parts()
+                params_np[:, s_base] = t1
+                params_np[:, s_base + 1] = t2
+                last_thresh = (t1, t2)
             _era_w0 = _time.monotonic()
             table, queue, rec_fp1, rec_fp2, params, disc_depth = self._block(
                 table, queue, rec_fp1, rec_fp2, jnp.asarray(params_np)
@@ -1498,6 +1645,7 @@ class ShardedBfsChecker(HostEngineBase):
             if self._memory is not None:
                 self._memory.attach("packed_params", params)
                 self._memory.attach("coverage_slab", params)
+                self._memory.attach("sample_slab", params)
             cur_budget = max_steps
             while True:
                 if not (
@@ -1562,8 +1710,15 @@ class ShardedBfsChecker(HostEngineBase):
                     and not any(self._spill[s] for s in range(N))
                     and max(per_shard_unique) + N * self._quota
                     <= vs.MAX_LOAD * self._tcap
+                    and (
+                        self._sampler is None
+                        or self._sampler.threshold_parts() == last_thresh
+                    )
                 ):
                     # Clean boundary: the chained block IS the next era.
+                    # (A tightened sampling threshold also breaks the chain
+                    # — stale thresholds are sound but over-capture; the
+                    # serial rebuild below uploads the fresh one.)
                     # grow_limit check mirrors the proactive-grow trigger
                     # above, so a growth boundary always falls through to
                     # the no-op discard below.
@@ -1682,6 +1837,11 @@ class ShardedBfsChecker(HostEngineBase):
             disc_depth_best={k: int(v) for k, v in disc_depth_best.items()},
             per_shard_unique=[int(u) for u in per_shard_unique],
             take_caps=[int(t) for t in take_caps],
+            sampler=(
+                self._sampler.export_state()
+                if self._sampler is not None
+                else None
+            ),
         )
         arrays = {
             "heads": np.asarray(heads, dtype=np.int64),
@@ -1746,6 +1906,8 @@ class ShardedBfsChecker(HostEngineBase):
         self._discovery_fps = {
             k: int(v) for k, v in meta["discovery_fps"].items()
         }
+        if self._sampler is not None and meta.get("sampler"):
+            self._sampler.restore_state(meta["sampler"])
         for s in range(self.n_shards):
             blocks = sorted(
                 (k for k in data if k.startswith(f"spill_{s}_")),
@@ -1826,6 +1988,11 @@ class ShardedBfsChecker(HostEngineBase):
             name: self._reconstruct(fp)
             for name, fp in list(self._discovery_fps.items())
         }
+
+    def _sample_resolver(self):
+        # Device slabs carry only (fp, depth): resolve sampled states
+        # lazily via cross-shard parent-pointer reconstruction.
+        return self._path_sample_resolver(self._reconstruct)
 
     def _reconstruct(self, fp64: int) -> Path:
         """Walk parent pointers ACROSS shard tables (owner = h1 % N per

@@ -37,6 +37,10 @@ class ReportData:
     # dead actions, depth histogram, property eval/hit counts). Populated
     # on the final sample; drives the dead-action warning block.
     coverage: Dict[str, Any] = None
+    # Engine space profile (obs/sample.py: bottom-k sample, field
+    # sketches, saturation warnings). Populated on the final sample;
+    # drives the one-line `Space.` recap + saturated-field warning.
+    space: Dict[str, Any] = None
 
 
 @dataclass
@@ -128,15 +132,18 @@ class WriteReporter(Reporter):
                 )
             if data.telemetry:
                 telemetry = dict(data.telemetry)
-                # The memory snapshot is a nested document; it gets its
-                # own compact line instead of bloating the pairs line.
+                # The memory and space snapshots are nested documents;
+                # they get their own compact lines instead of bloating
+                # the pairs line.
                 memory = telemetry.pop("memory", None)
+                telemetry.pop("space", None)
                 pairs = ", ".join(
                     f"{k}={v}" for k, v in sorted(telemetry.items())
                 )
                 self.writer.write(f"Telemetry. {pairs}\n")
                 self._report_memory(memory)
             self._report_coverage(data.coverage)
+            self._report_space(data.space)
         else:
             self.writer.write(
                 f"Checking. states={data.total_states}, "
@@ -194,6 +201,44 @@ class WriteReporter(Reporter):
             )
             for label in dead:
                 self.writer.write(f"  - {label}\n")
+
+    def _report_space(self, space) -> None:
+        """One compact space-profile line (obs/sample.py): sample size,
+        estimated space size, and the top-cardinality decoded fields —
+        the content twin of the `Coverage.` count line — plus a warning
+        when any sampled lane saturates its packed range (the runtime
+        twin of speclint STR209). The full profile stays in
+        ``Checker.space_profile()`` / the Explorer's ``GET /space``."""
+        if not space or not space.get("samples"):
+            return
+        parts = [
+            f"samples={space['samples']}/{space.get('k', space['samples'])}",
+            f"est_states={space.get('est_states', 0)}",
+        ]
+        fields = space.get("fields") or {}
+        if fields:
+            top = sorted(
+                fields.items(),
+                key=lambda kv: (-kv[1].get("distinct", 0), kv[0]),
+            )[:3]
+            parts.append(
+                "top_fields="
+                + ",".join(
+                    f"{name}({sk.get('distinct', 0)})" for name, sk in top
+                )
+            )
+        self.writer.write(f"Space. {', '.join(parts)}\n")
+        saturated = space.get("saturated") or []
+        if saturated:
+            names = ", ".join(
+                ent.get("field", f"lane[{ent['lane']}]")
+                + f"={ent['max']} ({ent['bits']}-bit edge)"
+                for ent in saturated
+            )
+            self.writer.write(
+                f"Warning. {len(saturated)} field(s) saturate their packed "
+                f"range — one step from wrapping (speclint STR209): {names}\n"
+            )
 
     def report_discoveries(self, model, discoveries: Dict[str, ReportDiscovery]) -> None:
         for name in sorted(discoveries):

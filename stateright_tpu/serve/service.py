@@ -1188,7 +1188,7 @@ class RunService:
             except Exception as e:  # forensics are best-effort
                 entry["explain_error"] = f"{type(e).__name__}: {e}"
             discoveries[name] = entry
-        return {
+        payload = {
             "engine": job.engine,
             "state_count": checker.state_count(),
             "unique_state_count": checker.unique_state_count(),
@@ -1197,3 +1197,10 @@ class RunService:
             "telemetry": checker.telemetry(),
             "coverage": checker.coverage(),
         }
+        try:
+            space = checker.space_profile()
+        except Exception:  # the profile is observability, never job-fatal
+            space = None
+        if space:
+            payload["space"] = space
+        return payload

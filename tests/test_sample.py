@@ -1,0 +1,245 @@
+"""Deterministic bottom-k state sampling (obs/sample.py).
+
+The tentpole contract: a state is sampled iff its 64-bit fingerprint is
+among the k smallest in the EXPLORED SET, so the sample is a pure
+function of that set — independent of engine, visitation order, shard
+layout, and pipelining. These tests lock the strongest form of that
+claim: exact sample-set equality between the host oracle, the
+single-device engine (pipelined and serial), and the sharded mesh; exact
+field sketches against exhaustive enumeration when k covers the space;
+and survival of the sample across a kill/resume checkpoint round-trip.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from stateright_tpu.models import IncrementTensor, TwoPhaseTensor
+from stateright_tpu.obs.sample import (
+    SpaceSampler,
+    build_space_profile,
+    detect_saturation,
+)
+from stateright_tpu.tensor import TensorModelAdapter
+
+OPTS = dict(chunk_size=64, queue_capacity=1 << 12, table_capacity=1 << 11)
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest should force 8 virtual CPU devices"
+    return devs[:8]
+
+
+def _host_fps(tm, k):
+    c = TensorModelAdapter(tm).checker().sample(k=k).spawn_bfs().join()
+    return c._sampler.fingerprints()
+
+
+# -- sampler unit behavior ----------------------------------------------------
+
+
+def test_bottom_k_keeps_exactly_the_k_smallest():
+    s = SpaceSampler(k=4)
+    fps = [90, 10, 50, 70, 30, 20, 60]
+    for fp in fps:
+        s.offer(fp, depth=1)
+    assert s.fingerprints() == sorted(fps)[:4]
+    # Threshold is the k-th smallest, exclusive: offers at/above reject.
+    assert s.threshold() == 50
+    assert not s.offer(50, depth=1)
+    assert not s.offer(51, depth=1)
+    assert s.offer(5, depth=1)
+    assert s.fingerprints() == [5, 10, 20, 30]
+
+
+def test_offer_dedups_and_backfills_richer_fields():
+    s = SpaceSampler(k=4)
+    assert s.offer(10, depth=3)
+    assert not s.offer(10, depth=3)  # duplicate fp: one sample
+    assert len(s.fingerprints()) == 1
+    s.offer(10, depth=3, state=(1, 2, 3))  # later offer backfills state
+    (rec,) = s.records()
+    assert rec["state"] == (1, 2, 3)
+
+
+def test_kmv_estimate_exact_below_k():
+    s = SpaceSampler(k=64)
+    for fp in range(1, 14):
+        s.offer(fp, depth=1)
+    # Below k the sample IS the population.
+    assert s.estimated_states() == 13
+
+
+def test_drain_slab_tie_cut_discards_boundary_h1_group():
+    # occupied > drained means the slab was truncated on device: entries
+    # AT the boundary h1 may be an incomplete tie group and must go.
+    s = SpaceSampler(k=2)
+    fp1 = np.array([1, 2, 2], dtype=np.uint64)
+    fp2 = np.array([5, 6, 7], dtype=np.uint64)
+    dep = np.array([1, 1, 1], dtype=np.uint64)
+    ok = np.array([1, 1, 1], dtype=np.uint64)
+    s.drain_slab(fp1, fp2, dep, ok, occupied=5)
+    # Only h1=1 survives (h1=2 is the boundary group), and keeping fewer
+    # than k flags the sample as degraded.
+    assert s.fingerprints() == [(1 << 32) | 5]
+    assert s.degraded
+    # exact=False (revisit-prone engines): duplicates, not truncation —
+    # the cut is skipped and nothing is flagged.
+    s2 = SpaceSampler(k=2)
+    s2.drain_slab(fp1, fp2, dep, ok, occupied=5, exact=False)
+    assert len(s2.fingerprints()) == 2
+    assert not s2.degraded
+
+
+def test_detect_saturation_flags_boundary_lanes():
+    rows = np.zeros((8, 3), dtype=np.uint64)
+    rows[:, 1] = np.arange(8)
+    rows[3, 1] = 255  # lane 1 tops out exactly at 2^8 - 1
+    rows[:, 2] = 12
+    (hit,) = detect_saturation(rows)
+    assert hit == {"lane": 1, "bits": 8, "max": 255, "hits": 1}
+    assert detect_saturation(rows[:, [0, 2]]) == []
+
+
+# -- cross-engine determinism -------------------------------------------------
+
+
+def test_sample_identical_host_vs_device_increment():
+    tm = IncrementTensor(2)
+    host = _host_fps(tm, k=8)
+    dev = (
+        TensorModelAdapter(tm)
+        .checker()
+        .sample(k=8)
+        .spawn_tpu_bfs(**OPTS)
+        .join()
+    )
+    assert dev._sampler.fingerprints() == host
+
+
+def test_sample_identical_host_vs_device_2pc4_pipelined_and_serial():
+    tm = TwoPhaseTensor(4)
+    host = _host_fps(tm, k=64)
+    for pipelined in (True, False):
+        dev = (
+            TensorModelAdapter(tm)
+            .checker()
+            .sample(k=64)
+            .pipeline(pipelined)
+            .spawn_tpu_bfs(**OPTS)
+            .join()
+        )
+        assert dev.unique_state_count() == 1568
+        assert dev._sampler.fingerprints() == host, f"pipeline={pipelined}"
+        assert not dev._sampler.degraded
+
+
+def test_sample_identical_host_vs_sharded_mesh(devices):
+    tm = TwoPhaseTensor(4)
+    host = _host_fps(tm, k=64)
+    mesh = (
+        TensorModelAdapter(tm)
+        .checker()
+        .sample(k=64)
+        .spawn_sharded_bfs(devices=devices[:4], chunk_size=64)
+        .join()
+    )
+    assert mesh.unique_state_count() == 1568
+    assert mesh._sampler.fingerprints() == host
+    # Device slabs drain fingerprint-only; the profile resolves every
+    # sampled state via cross-shard path reconstruction.
+    profile = mesh.space_profile()
+    assert profile["unresolved"] == 0
+    assert profile["fields"]
+
+
+def test_sample_survives_kill_and_resume(tmp_path):
+    tm = TwoPhaseTensor(5)
+    golden = _host_fps(tm, k=32)
+    ckpt = str(tmp_path / "sample.ckpt.npz")
+    partial = (
+        TensorModelAdapter(tm)
+        .checker()
+        .sample(k=32)
+        .target_state_count(2_000)
+        .spawn_tpu_bfs(checkpoint_path=ckpt, **OPTS)
+        .join()
+    )
+    assert 0 < partial.unique_state_count() < 8832
+    resumed = (
+        TensorModelAdapter(tm)
+        .checker()
+        .sample(k=32)
+        .spawn_tpu_bfs(resume_from=ckpt, **OPTS)
+        .join()
+    )
+    assert resumed.unique_state_count() == 8832
+    # The checkpoint carries the sampler (threshold + records): the
+    # resumed run's sample equals an uninterrupted run's exactly.
+    assert resumed._sampler.fingerprints() == golden
+
+
+# -- sketch exactness against exhaustive enumeration --------------------------
+
+
+def test_sketches_exact_when_k_covers_the_space():
+    tm = IncrementTensor(2)
+    adapter = TensorModelAdapter(tm)
+    checker = adapter.checker().sample(k=64).spawn_bfs().join()
+    sampler = checker._sampler
+    # k=64 >= 13 reachable states: the sample IS the space.
+    assert len(sampler.fingerprints()) == 13
+    assert sampler.estimated_states() == 13
+
+    profile = checker.space_profile()
+    # Exhaustive oracle: decode every sampled state row and flatten the
+    # same way the profile does; sketches must agree exactly.
+    fields = profile["fields"]
+    assert fields
+    for name, sk in fields.items():
+        assert sk["count"] == 13, name
+    # Depth exemplars partition the sample: counts sum to the space.
+    assert sum(d["count"] for d in profile["depths"].values()) == 13
+    # Every non-init sample carries its generating action exemplar.
+    n_inits = len(np.asarray(tm.init_states_array()))
+    assert sum(a["count"] for a in profile["actions"].values()) == 13 - n_inits
+
+
+def test_profile_exposed_via_telemetry_and_gauges():
+    checker = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .sample(k=8)
+        .spawn_bfs()
+        .join()
+    )
+    tel = checker.telemetry()
+    space = tel["space"]
+    assert space["samples"] == 8
+    # Flat gauge twins for Prometheus/SSE sit beside the nested doc.
+    assert tel["space_samples"] == 8
+    assert tel["space_sample_k"] == 8
+    assert tel["space_est_states"] > 0
+
+
+def test_sample_disabled_is_clean():
+    checker = (
+        TensorModelAdapter(IncrementTensor(2))
+        .checker()
+        .sample(False)
+        .spawn_tpu_bfs(**OPTS)
+        .join()
+    )
+    assert checker.space_profile() == {}
+    assert "space" not in checker.telemetry()
+
+
+def test_build_space_profile_counts_unresolved_rows():
+    s = SpaceSampler(k=4)
+    s.offer(10, depth=1)  # no state row, no resolver: stays unresolved
+    profile = build_space_profile(
+        TensorModelAdapter(IncrementTensor(2)), s, resolver=None
+    )
+    assert profile["unresolved"] == 1
