@@ -113,8 +113,17 @@ LANE_BYTES = 4  # every lane is uint32
 
 # Peak HBM bandwidth assumed by `--roofline`, GB/s. Deliberately an env
 # knob, not a hardcoded chip claim — set STATERIGHT_TPU_HBM_GBPS to your
-# part's datasheet number when reading the table.
-HBM_GBPS_DEFAULT = 819.0
+# part's datasheet number when reading the table. The default is
+# single-sourced with the STR606 program-lint roofline
+# (stateright_tpu/analysis/program.py) so the analytic and the
+# XLA-cost-model predictions never assume different hardware; imported
+# lazily to keep the no-jax `--check` path import-free.
+
+
+def _hbm_gbps_default() -> float:
+    from stateright_tpu.analysis.program import HBM_GBPS_DEFAULT
+
+    return HBM_GBPS_DEFAULT
 
 
 def roofline_report(
@@ -150,7 +159,7 @@ def roofline_report(
 
     if hbm_gbps is None:
         hbm_gbps = float(
-            os.environ.get("STATERIGHT_TPU_HBM_GBPS", HBM_GBPS_DEFAULT)
+            os.environ.get("STATERIGHT_TPU_HBM_GBPS", _hbm_gbps_default())
         )
     S = int(state_width)
     A = max(1, int(max_actions))
@@ -657,12 +666,14 @@ def main() -> int:
     # time on it (a fast engine checking a broken spec benches nothing);
     # diagnostic counts per code ride the BENCH json next to telemetry.
     from stateright_tpu.analysis import analyze
+    from stateright_tpu.analysis.program import program_summary
 
     from stateright_tpu.models import AbdOrderedTensor as _AbdO
     from stateright_tpu.models import AbdTensor as _Abd
     from stateright_tpu.models import SingleCopyTensor as _SC
 
     speclint = {}
+    program_static = {}
     for mk in (
         lambda: TwoPhaseTensor(7),
         lambda: PaxosTensorExhaustive(2),
@@ -686,7 +697,30 @@ def main() -> int:
             f"speclint found errors on bench model {type(m).__name__}:\n"
             + rep.format()
         )
+        # Static program section (proglint deep tier, STR6xx): per-program
+        # op counts plus the STR606 cost model — flops/bytes per era step
+        # and the memory-bound predicted st/s. Running it here also primes
+        # the program-summary cache, so each device run's telemetry below
+        # carries the predicted-vs-measured attribution for free.
+        summ = program_summary(m, cost=True)
+        ent = {
+            "signature": summ.get("signature"),
+            "ops": {
+                name: p.get("ops")
+                for name, p in (summ.get("programs") or {}).items()
+            },
+        }
+        cost_d = summ.get("cost") or {}
+        for ck in (
+            "flops_per_step",
+            "bytes_per_step",
+            "predicted_states_per_sec",
+        ):
+            if cost_d.get(ck) is not None:
+                ent[ck] = round(float(cost_d[ck]), 1)
+        program_static[type(m).__name__] = ent
     detail["speclint"] = speclint
+    detail["program_static"] = program_static
 
     def emit(value, vs_baseline, partial):
         result.update(

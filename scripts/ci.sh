@@ -3,46 +3,52 @@
 #
 #   scripts/ci.sh
 #
-# Twelve stages, fail-fast:
-#   1. ruff over the repo (mechanical lint scope; see ruff.toml),
+# Thirteen stages, fail-fast:
+#   1. ruff over the repo (mechanical lint scope; see ruff.toml) — a hard
+#      failure when $CI is set, a loud skip on dev machines without it,
 #   2. the speclint dogfood — every bundled model must analyze with zero
 #      error-severity findings (`python -m stateright_tpu.analysis`),
-#   3. a stage-profiler smoke: one tiny device-engine run with
+#   3. the proglint dogfood — every bundled TensorModel's device programs
+#      must pass the deep STR6xx tier (`--program`: transfer/donation/
+#      dtype detectors, the committed op-count budgets, the STR606 cost
+#      model), and a deliberately perturbed budget file must TRIP the
+#      STR604 gate — proving the ratchet actually fails CI,
+#   4. a stage-profiler smoke: one tiny device-engine run with
 #      `.stage_profile()` must populate the per-stage era breakdown and
 #      reconcile with the era wall time within 10%,
-#   4. a conformance smoke: the replicated counter runs ~1s on loopback
+#   5. a conformance smoke: the replicated counter runs ~1s on loopback
 #      UDP under seeded drop/duplicate/delay faults, records a trace, and
 #      the trace must conform against the actor model with ZERO
 #      divergences and yield a nonzero linearizable client history,
-#   5. a serve smoke: the run server admits a 2pc-3 check plus a batch of
+#   6. a serve smoke: the run server admits a 2pc-3 check plus a batch of
 #      8 small increment checks over REST, multiplexes the batch into one
 #      fused executable, matches the golden state counts, and reports an
 #      executable-cache hit on resubmission,
-#   6. a durability smoke: a checkpointed 2pc-5 device run is stopped
+#   7. a durability smoke: a checkpointed 2pc-5 device run is stopped
 #      mid-flight, resumed from its crash-safe checkpoint to the exact
 #      golden, and a journaled run service is killed with queued jobs and
 #      restarted — every job must recover and finish,
-#   7. an observability smoke: one submitted job must yield span events
+#   8. an observability smoke: one submitted job must yield span events
 #      over the /events SSE stream, histogram _bucket series in
 #      /metrics.prom, and a Chrome-trace export that JSON-parses with
 #      matching B/E pairs,
-#   8. a perf-gate smoke: `bench.py --smoke` (tiny 2pc-5 device run)
+#   9. a perf-gate smoke: `bench.py --smoke` (tiny 2pc-5 device run)
 #      seeds a throwaway history, a parity rerun must pass the gate,
 #      and a BENCH_PERTURB_SLEEP-degraded rerun must trip it — proving
 #      `bench.py --gate` actually fails CI on a real regression,
-#   9. a pipelining smoke: a tiny run with speculative era dispatch
+#  10. a pipelining smoke: a tiny run with speculative era dispatch
 #      forced ON (many short eras) must golden-match the serial driver
 #      bit-for-bit and report a flight summary with `host_gap_pct`,
-#  10. a memory smoke: the capacity planner predicts a small run's
+#  11. a memory smoke: the capacity planner predicts a small run's
 #      footprint before dispatch, the run's memory ledger must match
 #      the live buffers' nbytes EXACTLY and the planner's prediction,
 #      and the `memory_bytes{component=...}` series must render in the
 #      Prometheus exposition,
-#  11. a space smoke: the deterministic bottom-k state sample from a
+#  12. a space smoke: the deterministic bottom-k state sample from a
 #      pipelined device run must equal the host oracle's sample
 #      EXACTLY, the profile must carry field sketches, and the
 #      `space_*` gauges must render in the Prometheus exposition,
-#  12. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#  13. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -52,19 +58,61 @@ if command -v ruff >/dev/null 2>&1; then
   ruff check .
 elif python -m ruff --version >/dev/null 2>&1; then
   python -m ruff check .
+elif [ -n "${CI:-}" ]; then
+  # A CI lane without the linter is a misconfigured lane, not a lane
+  # that gets to skip linting.
+  echo "ERROR: \$CI is set but ruff is not installed" >&2
+  exit 1
 else
-  # The gate must stay runnable in containers without the linter baked
-  # in; skipping is LOUD so a real CI lane still notices.
+  # Dev machines stay runnable without the linter baked in; skipping is
+  # LOUD so the gap is still visible.
   echo "WARNING: ruff not installed; skipping the lint stage" >&2
 fi
 
 echo "== speclint dogfood =="
-for model in 2pc:4 2pc-host:3 abd:2 abd-ordered:2 increment:2 \
-             increment-host:2 increment-lock:2 increment-lock-host:2 \
-             paxos:2 single-copy:2,2; do
+for model in 2pc:4 2pc-host:3 abd:2 abd-ordered:2 binary-clock \
+             increment:2 increment-host:2 increment-lock:2 \
+             increment-lock-host:2 linear-equation:1,2,20 \
+             linearizable-register:2,2 lww-register:2 paxos:2 \
+             single-copy:2,2 write-once-register:2; do
   echo "-- $model"
   JAX_PLATFORMS=cpu python -m stateright_tpu.analysis "$model"
 done
+
+echo "== proglint dogfood =="
+# The deep STR6xx tier over every bundled TensorModel: trace + scan all
+# five device programs, gate op counts against the committed budgets
+# (analysis/op_budgets.json), and run the STR606 compile + cost model.
+for model in 2pc:4 2pc:7 abd:2 abd-ordered:2 increment:2 \
+             increment-lock:2 paxos:2 single-copy:2,2; do
+  echo "-- $model"
+  JAX_PLATFORMS=cpu python -m stateright_tpu.analysis "$model" --program
+done
+
+# The ratchet must-fail smoke: shrink one committed budget by one op so
+# the measured count EXCEEDS it — the STR604 gate must fail the lint.
+proglint_tmp="$(mktemp -d /tmp/_proglint_smoke.XXXXXX)"
+JAX_PLATFORMS=cpu python - "$proglint_tmp/budgets.json" <<'PY'
+import json
+import sys
+
+from stateright_tpu.analysis.program import BUDGETS_PATH
+from stateright_tpu.engines.compiled import model_signature
+from stateright_tpu.models import TwoPhaseTensor
+
+doc = json.load(open(BUDGETS_PATH))
+key = f"tpu_bfs|{model_signature(TwoPhaseTensor(4))}"
+doc["entries"][key]["ops"] -= 1
+print(f"perturbed {key}: budget now {doc['entries'][key]['ops']} ops")
+json.dump(doc, open(sys.argv[1], "w"))
+PY
+if JAX_PLATFORMS=cpu python -m stateright_tpu.analysis 2pc:4 --program \
+   --budgets "$proglint_tmp/budgets.json"; then
+  echo "proglint smoke FAILED: op-count growth passed the STR604 gate" >&2
+  exit 1
+fi
+rm -rf "$proglint_tmp"
+echo "proglint smoke OK: budgets green, perturbed budget tripped STR604"
 
 echo "== stage-profiler smoke =="
 JAX_PLATFORMS=cpu python - <<'PY'

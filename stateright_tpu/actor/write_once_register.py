@@ -175,3 +175,63 @@ class WORegisterClient(Actor):
         if isinstance(msg, GetOk) and msg.request_id == state.awaiting:
             return WORegisterClientState(awaiting=None, op_count=state.op_count + 1)
         return None
+
+
+# -- a bundled demo system (speclint dogfood / examples) ----------------------
+
+
+class FirstWriteWinsServer(Actor):
+    """Accepts only the first write; later writes of other values fail
+    (the minimal server honoring write-once semantics)."""
+
+    def on_start(self, id: Id, out: Out) -> None:
+        return None
+
+    def on_msg(self, id: Id, state: Any, src: Id, msg: Any, out: Out):
+        if isinstance(msg, Put):
+            if state is None or state == msg.value:
+                out.send(src, PutOk(msg.request_id))
+                return msg.value
+            out.send(src, PutFail(msg.request_id))
+            return None
+        if isinstance(msg, Get):
+            out.send(src, GetOk(msg.request_id, state))
+            return None
+        return None
+
+
+def wo_register_model(client_count: int = 2):
+    """One first-write-wins server + `client_count` clients, checked for
+    linearizability against `WORegister` via the kit's history hooks.
+    The `write-once-register` shorthand in the speclint CLI."""
+    from .. import Expectation
+    from ..semantics import LinearizabilityTester
+    from ..semantics.write_once_register import WORegister
+    from .model import ActorModel
+    from .network import Network
+
+    return (
+        ActorModel(init_history=LinearizabilityTester(WORegister()))
+        .actor(FirstWriteWinsServer())
+        .add_actors(
+            WORegisterClient(put_count=1, server_count=1)
+            for _ in range(client_count)
+        )
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda model, state: state.history.serialized_history()
+            is not None,
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "a write fails",
+            lambda model, state: any(
+                isinstance(env.msg, PutFail)
+                for env in state.network.iter_deliverable()
+            ),
+        )
+        .with_record_msg_in(record_returns)
+        .with_record_msg_out(record_invocations)
+    )

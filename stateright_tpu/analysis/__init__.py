@@ -21,7 +21,14 @@ state space and runs five rule families:
   5. spawnability (STR5xx, analysis/spawnability.py; ActorModels) —
      sampled in-flight messages must survive the `json_serializer`
      wire round-trip, or a deployed run silently drops/corrupts them
-     (and trace conformance reports spurious divergences).
+     (and trace conformance reports spurious divergences);
+  6. compiled programs (STR6xx "proglint", analysis/program.py;
+     TensorModels) — the device era/seed/insert/mux/sharded programs
+     lowered to jaxpr/StableHLO WITHOUT executing, scanned for host
+     transfers in the hot loop, dropped buffer donation, dtype drift,
+     op-count budget regressions (analysis/op_budgets.json), signature
+     instability, and (with ``program_cost=True``) an XLA-cost-model
+     predicted roofline.
 
 Wire-in points:
 
@@ -44,7 +51,7 @@ import numpy as np
 from ..core import Model
 from ..tensor import TensorModel, TensorModelAdapter
 from ..actor.model import ActorModel
-from . import determinism, device, properties, spawnability, symmetry
+from . import determinism, device, program, properties, spawnability, symmetry
 from .diagnostics import (
     AnalysisReport,
     Diagnostic,
@@ -65,7 +72,9 @@ __all__ = [
     "sample_states",
 ]
 
-ALL_FAMILIES = ("determinism", "device", "properties", "symmetry", "spawn")
+ALL_FAMILIES = (
+    "determinism", "device", "properties", "symmetry", "spawn", "program",
+)
 
 # Device-rule batch width: tracing/executing step_lanes on more rows buys
 # no additional coverage for shape/dtype/divergence findings, and keeps
@@ -80,6 +89,8 @@ def analyze(
     families: Iterable[str] = ALL_FAMILIES,
     symmetry_fn: Optional[Callable[[Any], Any]] = None,
     orbit_fn: Optional[Callable[[Any], List[Any]]] = None,
+    program_cost: bool = False,
+    budgets_path: Optional[str] = None,
 ) -> AnalysisReport:
     """Statically analyze `model` before spending a checking run on it.
 
@@ -91,7 +102,10 @@ def analyze(
     explicit canonicalizer (e.g. the one handed to
     `CheckerBuilder.symmetry_fn`); `orbit_fn(state) -> [equivalent
     states]` additionally cross-checks representative agreement across a
-    known symmetry orbit.
+    known symmetry orbit. `program_cost` widens the STR6xx program
+    family to the full device-program set plus the compiled STR606 cost
+    model (the CLI's ``--program``); `budgets_path` overrides the
+    committed op-budget file (tests).
 
     Returns an `AnalysisReport`; `report.ok` is False iff any finding is
     error-severity (those mean the checker's verdicts cannot be trusted).
@@ -150,4 +164,9 @@ def analyze(
         )
     if "spawn" in families and isinstance(host, ActorModel):
         spawnability.run(host, sample, report)
+    if "program" in families and tm is not None:
+        # `program_cost` widens the pass to every device program plus the
+        # STR606 compile + cost model (seconds); the default tier stays
+        # cheap enough for strict mode and serve admission.
+        program.run(tm, report, cost=program_cost, budgets_path=budgets_path)
     return report

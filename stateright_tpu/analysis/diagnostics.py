@@ -10,6 +10,9 @@ group by rule family:
   ``STR3xx``  property well-formedness
   ``STR4xx``  symmetry-reduction soundness
   ``STR5xx``  spawnability (wire round-trip) of ActorModel messages
+  ``STR6xx``  compiled-program lint ("proglint"): static jaxpr/StableHLO
+              analysis of the device programs — transfers, donation,
+              dtype drift, op budgets, signature stability, cost model
 
 The full code -> meaning -> fix catalog lives in `analysis/README.md`
 (mirroring the obs metric-name catalog in obs/metrics.py).

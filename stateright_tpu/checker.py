@@ -275,9 +275,16 @@ class CheckerBuilder:
 
     # -- static analysis (speclint; stateright_tpu.analysis) -----------------
 
-    def lint(self, samples: int = 256) -> Any:
+    def lint(self, samples: int = 256, program_cost: bool = False) -> Any:
         """Run the speclint pre-flight over this builder's model and
         symmetry options WITHOUT launching an engine.
+
+        Tensor-backed models additionally get the STR6xx program family:
+        the compiled era loop is lowered (never executed) and scanned
+        for host transfers, dropped donation, dtype drift, and op-budget
+        regressions; ``program_cost=True`` widens that to every device
+        program plus the STR606 cost-model roofline (seconds — the CLI's
+        ``--program``).
 
         Returns an `analysis.AnalysisReport`; its diagnostic counts are
         also exported through `Checker.telemetry()` (as ``lint_<code>``
@@ -296,6 +303,7 @@ class CheckerBuilder:
             self.model,
             samples=samples,
             symmetry_fn=None if tensorish else self.symmetry_fn_,
+            program_cost=program_cost,
         )
         return self.lint_report_
 

@@ -359,8 +359,55 @@ class HostEngineBase(Checker):
             snap["memory"] = self._memory.snapshot()
         if self._sampler is not None and self._sampler.size():
             snap["space"] = self._sampler.snapshot()
+        program = self._program_snapshot(snap)
+        if program:
+            snap["program"] = program
         snap["engine"] = type(self).__name__
         return snap
+
+    def _program_snapshot(self, snap: Dict[str, Any]) -> Dict[str, Any]:
+        """The STR6xx static program summary for this run's model, when a
+        program-lint pass has produced one this process (a cached dict
+        lookup — this NEVER traces or compiles), with the flight
+        recorder's measured rate beside the STR606 prediction as an
+        attribution ratio: measured/predicted ≈ 1 means the roofline
+        explains the run; << 1 means dispatch gap or host stalls own it."""
+        tm = getattr(self._model, "tm", None)
+        if tm is None:
+            return {}
+        try:
+            from ..analysis.program import cached_summary
+            from .compiled import model_signature
+
+            summary = cached_summary(model_signature(tm))
+        except Exception:
+            return {}
+        if not summary:
+            return {}
+        era = summary.get("programs", {}).get("era_loop", {})
+        out: Dict[str, Any] = {
+            "signature": summary.get("signature"),
+            "era_ops": era.get("ops"),
+            "era_distinct_ops": era.get("distinct"),
+        }
+        cost = summary.get("cost") or {}
+        if cost:
+            out.update(
+                {
+                    "flops_per_step": cost.get("flops_per_step"),
+                    "bytes_per_step": cost.get("bytes_per_step"),
+                    "hbm_gbps": cost.get("hbm_gbps"),
+                }
+            )
+            predicted = cost.get("predicted_states_per_sec")
+            if predicted:
+                out["predicted_states_per_sec"] = predicted
+                wall = (snap.get("flight") or {}).get("wall_secs") or 0.0
+                if wall > 0 and self._state_count:
+                    measured = self._state_count / wall
+                    out["measured_states_per_sec"] = measured
+                    out["attribution_ratio"] = measured / predicted
+        return out
 
     def coverage(self) -> Dict[str, Any]:
         """The run's coverage snapshot (obs/coverage.py)."""

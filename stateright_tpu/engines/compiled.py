@@ -47,6 +47,7 @@ from ..tensor import TensorModel, TensorModelAdapter
 __all__ = [
     "CompiledCheck",
     "ExecutableCache",
+    "era_geometry",
     "intern_model",
     "model_signature",
 ]
@@ -111,6 +112,42 @@ def intern_model(model: Any) -> Tuple[TensorModel, str]:
     return tm, sig
 
 
+def era_geometry(model: Any, options: Optional[Dict[str, Any]] = None) -> Dict[str, int]:
+    """The solo engine shape a default run compiles at, resolved from
+    `options` exactly like `CompiledCheck.warm()` / `spawn_tpu_bfs`:
+    chunk clamp, coverage/sample defaults, and the proactive table
+    pre-growth. Single source of truth shared by `warm()` and the
+    STR6xx program lint (analysis/program.py) — if lint lowered at a
+    different shape its op budgets would gate a program no run executes.
+    """
+    from ..obs.sample import DEFAULT_SAMPLE_K
+    from ..ops import visited_set as vs
+    from .tpu_bfs import _vcap
+
+    tm = _tm_of(model)
+    options = options or {}
+    qcap = int(options.get("queue_capacity", 1 << 20))
+    tcap = int(options.get("table_capacity", 1 << 22))
+    chunk = min(
+        int(options.get("chunk_size", 8192)),
+        qcap // (2 * max(1, tm.max_actions)),
+    )
+    cov = bool(options.get("coverage", True))
+    sample_k = int(options.get("sample_k", DEFAULT_SAMPLE_K))
+    n_init = len(tm.init_states_array())
+    vcap = _vcap(tm.max_actions, chunk)
+    while n_init + vcap > vs.MAX_LOAD * tcap:
+        tcap *= 2
+    return {
+        "chunk": chunk,
+        "qcap": qcap,
+        "tcap": tcap,
+        "cov": cov,
+        "sample_k": sample_k,
+        "n_init": n_init,
+    }
+
+
 class CompiledCheck:
     """One warm checking executable: an interned model + engine shape.
 
@@ -139,29 +176,17 @@ class CompiledCheck:
         if self._warmed:
             return self
         if self.engine == "tpu_bfs":
-            from .tpu_bfs import _build_loop, _build_seed_loop, _vcap
-            from ..ops import visited_set as vs
+            from .tpu_bfs import _build_loop, _build_seed_loop
 
             tm = self.tm
             props = tm.tensor_properties()
-            qcap = int(self.options.get("queue_capacity", 1 << 20))
-            tcap = int(self.options.get("table_capacity", 1 << 22))
-            chunk = min(
-                int(self.options.get("chunk_size", 8192)),
-                qcap // (2 * max(1, tm.max_actions)),
-            )
-            cov = bool(self.options.get("coverage", True))
-            # Space sampling defaults ON at k=64 (CheckerBuilder.sample);
-            # warm the loop at the same shape a default run compiles.
-            from ..obs.sample import DEFAULT_SAMPLE_K
-
-            sample_k = int(self.options.get("sample_k", DEFAULT_SAMPLE_K))
-            # Mirror the engine's proactive pre-growth so the seed loop is
-            # traced at the table capacity a run will actually use.
-            n_init = len(tm.init_states_array())
-            vcap = _vcap(tm.max_actions, chunk)
-            while n_init + vcap > vs.MAX_LOAD * tcap:
-                tcap *= 2
+            # Space sampling defaults ON at k=64 (CheckerBuilder.sample) and
+            # the engine pre-grows the table proactively; era_geometry()
+            # mirrors both, so the loop is traced at the shape a default
+            # run actually compiles.
+            g = era_geometry(tm, self.options)
+            chunk, qcap, tcap = g["chunk"], g["qcap"], g["tcap"]
+            cov, sample_k = g["cov"], g["sample_k"]
             _build_loop(tm, props, chunk, qcap, False, cov, sample_k=sample_k)
             _build_seed_loop(
                 tm, props, chunk, qcap, tcap, False, cov, sample_k=sample_k

@@ -158,6 +158,62 @@ def _vcap(A: int, chunk: int) -> int:
     return min(chunk * A, max(128 * A, (chunk * A) // div))
 
 
+def params_len(A: int, P: int, cov: bool, sample_k: int) -> int:
+    """Length of the packed uint32 params vector the era loop carries:
+    scalars + rec_fp tail + optional coverage tail + optional sampling
+    tail. This is THE layout contract — the engine, the checkpoint codec,
+    and the STR6xx program lint all size their buffers from it."""
+    n = P_LEN + 2 * P
+    if cov:
+        n += _cov_len(A, P)
+    if sample_k:
+        from ..obs.sample import slab_entries
+
+        n += 4 + 5 * slab_entries(sample_k)
+    return n
+
+
+def loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
+                       tcap: int, cov: bool, sample_k: int):
+    """`jax.ShapeDtypeStruct` pytree matching `_build_loop`'s signature
+    `(table, queue, rec_fp1, rec_fp2, params)` — lets the STR6xx program
+    lint (analysis/program.py) trace/lower the era loop WITHOUT
+    allocating a single device buffer or executing anything."""
+    import jax
+    import jax.numpy as jnp
+
+    S, A, P = tm.state_width, tm.max_actions, len(props)
+    u32 = jnp.uint32
+    sds = jax.ShapeDtypeStruct
+    table = (sds((2 * tcap,), u32), sds((tcap,), u32), sds((tcap,), u32))
+    queue = tuple(sds((qcap,), u32) for _ in range(S + 2))
+    plen = params_len(A, P, cov, sample_k)
+    return (table, queue, sds((P,), u32), sds((P,), u32), sds((plen,), u32))
+
+
+def seed_loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
+                            tcap: int, cov: bool, sample_k: int,
+                            n_init: int):
+    """Abstract args for `_build_seed_loop`'s fused
+    `seed_run(qinit, h1, h2, params, rec_fp1, rec_fp2)` dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    S, A, P = tm.state_width, tm.max_actions, len(props)
+    u32 = jnp.uint32
+    sds = jax.ShapeDtypeStruct
+    n_init = max(1, n_init)
+    plen = params_len(A, P, cov, sample_k)
+    return (
+        sds((S + 2, n_init), u32),
+        sds((n_init,), u32),
+        sds((n_init,), u32),
+        sds((plen,), u32),
+        sds((P,), u32),
+        sds((P,), u32),
+    )
+
+
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False,
                 cov: bool = True, raw: bool = False, sample_k: int = 0):
     """Compile the BFS device "era" loop.

@@ -126,6 +126,22 @@ def empty_table(capacity: int):
     )
 
 
+def abstract_table(capacity: int):
+    """`jax.ShapeDtypeStruct` twin of `empty_table` — the shapes without
+    the buffers, for tracing/lowering insert/rehash programs statically
+    (analysis/program.py STR6xx)."""
+    import jax
+
+    if capacity & (capacity - 1):
+        raise ValueError("visited-set capacity must be a power of two")
+    sds = jax.ShapeDtypeStruct
+    return (
+        sds((2 * capacity,), jnp.uint32),
+        sds((capacity,), jnp.uint32),
+        sds((capacity,), jnp.uint32),
+    )
+
+
 def table_capacity(table) -> int:
     return table[1].shape[0]
 

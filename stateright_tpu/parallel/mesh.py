@@ -75,6 +75,50 @@ SHARD_IMBALANCE_WARN = 4.0
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
+def shard_params_len(A: int, P: int, cov: bool, sample_k: int) -> int:
+    """Length of one shard's packed uint32 params row: scalars +
+    optional coverage tail + optional sampling tail ([T1,T2,occ,0] and
+    four drained lanes). Mirrors `engines.tpu_bfs.params_len` minus the
+    rec_fp tail (the sharded block passes rec_fp as separate args)."""
+    from ..obs.coverage import DEPTH_CAP
+
+    n = P_LEN + ((A + P + 1 + DEPTH_CAP) if cov else 0)
+    if sample_k:
+        from ..obs.sample import slab_entries
+
+        n += 4 + 4 * slab_entries(sample_k)
+    return n
+
+
+def block_abstract_args(tm: TensorModel, props, qcap: int, tcap: int,
+                        n_shards: int, cov: bool, sample_k: int):
+    """`jax.ShapeDtypeStruct` pytree matching `_build_block`'s jitted
+    signature `(table, queue, rec_fp1, rec_fp2, params)` — global shapes
+    with the leading shard axis. Used by the STR6xx program lint to
+    lower the sharded era block without touching device memory."""
+    import jax
+    import jax.numpy as jnp
+
+    S, A, P = tm.state_width, tm.max_actions, len(props)
+    u32 = jnp.uint32
+    sds = jax.ShapeDtypeStruct
+    N = n_shards
+    table = (
+        sds((N, 2 * tcap), u32),
+        sds((N, tcap), u32),
+        sds((N, tcap), u32),
+    )
+    queue = tuple(sds((N, qcap), u32) for _ in range(S + 2))
+    plen = shard_params_len(A, P, cov, sample_k)
+    return (
+        table,
+        queue,
+        sds((N, P), u32),
+        sds((N, P), u32),
+        sds((N, plen), u32),
+    )
+
+
 def _build_block(tm: TensorModel, props, chunk: int, qcap: int, n_shards: int,
                  quota: int, mesh, axis: str, cov: bool = True,
                  sample_k: int = 0):

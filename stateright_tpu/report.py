@@ -132,16 +132,18 @@ class WriteReporter(Reporter):
                 )
             if data.telemetry:
                 telemetry = dict(data.telemetry)
-                # The memory and space snapshots are nested documents;
-                # they get their own compact lines instead of bloating
-                # the pairs line.
+                # The memory, space, and program snapshots are nested
+                # documents; they get their own compact lines instead of
+                # bloating the pairs line.
                 memory = telemetry.pop("memory", None)
                 telemetry.pop("space", None)
+                program = telemetry.pop("program", None)
                 pairs = ", ".join(
                     f"{k}={v}" for k, v in sorted(telemetry.items())
                 )
                 self.writer.write(f"Telemetry. {pairs}\n")
                 self._report_memory(memory)
+                self._report_program(program)
             self._report_coverage(data.coverage)
             self._report_space(data.space)
         else:
@@ -174,6 +176,28 @@ class WriteReporter(Reporter):
         self.writer.write(f"Memory. {', '.join(parts)}\n")
         if memory.get("warning"):
             self.writer.write(f"Warning. {memory['warning']}\n")
+
+    def _report_program(self, program) -> None:
+        """The STR606 predicted-vs-achieved roofline recap: the static
+        cost model's predicted st/s next to the measured rate, with
+        their ratio. attribution≈1 means the memory-bound roofline
+        explains the run; attribution<<1 points at the dispatch gap or
+        host stalls (see analysis/README.md, "Reading the roofline").
+        Printed only when a program-lint pass ran for this model."""
+        if not program or not program.get("predicted_states_per_sec"):
+            return
+        parts = [
+            f"predicted={_fmt_rate(program['predicted_states_per_sec'])}",
+        ]
+        if program.get("measured_states_per_sec"):
+            parts.append(
+                f"measured={_fmt_rate(program['measured_states_per_sec'])}"
+            )
+        if program.get("attribution_ratio") is not None:
+            parts.append(f"attribution={program['attribution_ratio']:.2f}")
+        if program.get("era_ops"):
+            parts.append(f"era_ops={program['era_ops']}")
+        self.writer.write(f"Program. {', '.join(parts)}\n")
 
     def _report_coverage(self, coverage) -> None:
         """The final coverage summary + dead-action warning block.

@@ -483,9 +483,15 @@ class RunService:
             return code, body
 
         # Speclint admission gate: reject broken specs BEFORE any compile.
+        # For tensor models this includes the STR6xx program family — a
+        # job whose COMPILED program is broken (hot-loop host callbacks,
+        # over-budget op growth, dropped donation) is refused before the
+        # ExecutableCache ever warms it.
         report = self._lint(spec, signature, model)
         if not report.ok:
             self.metrics.inc("serve_rejected_lint")
+            if any(d.code.startswith("STR6") for d in report.errors):
+                self.metrics.inc("serve_rejected_proglint")
             return 422, {
                 "error": "speclint rejected the model "
                 f"({sum(report.counts_by_code().values())} findings)",

@@ -18,6 +18,7 @@ import urllib.error
 import urllib.request
 from typing import List
 
+import numpy as np
 import pytest
 
 from stateright_tpu import Model, Property, TensorModelAdapter
@@ -252,6 +253,48 @@ def test_lint_admission_gate_rejects_with_strxxx_codes(server, monkeypatch):
     codes = {d["code"] for d in body["diagnostics"]["diagnostics"]}
     assert codes & {"STR101", "STR102"}
     telemetry = _req(server, "GET", "/metrics")[1]
+    assert telemetry["serve_rejected_lint"] >= 1
+
+
+class CallbackIncrementTensor(IncrementTensor):
+    """STR601 fixture: a host callback hidden inside the hot-loop program.
+
+    The state-space families cannot see it (numpy and jax evaluations
+    agree — the probe is multiplied by zero); only the STR6xx program
+    lint, which scans the traced jaxpr, catches it.
+    """
+
+    def step_lanes(self, xp, lanes):
+        succs, masks = super().step_lanes(xp, lanes)
+        if xp is not np:
+            import jax
+
+            probe = jax.pure_callback(
+                lambda x: np.asarray(x, dtype=np.uint32),
+                jax.ShapeDtypeStruct(lanes[0].shape, np.uint32),
+                lanes[0],
+            )
+            first = list(succs[0])
+            first[0] = first[0] + probe * xp.uint32(0)
+            succs = [tuple(first)] + list(succs[1:])
+        return succs, masks
+
+
+def test_proglint_admission_gate_rejects_compiled_program(server, monkeypatch):
+    """A spec whose COMPILED program is broken is refused before any
+    compile, and the refusal is attributed to the program family."""
+    from stateright_tpu.analysis import __main__ as registry
+
+    monkeypatch.setitem(
+        registry.BUNDLED, "callback-broken", CallbackIncrementTensor
+    )
+    code, body = _req(server, "POST", "/submit", {"spec": "callback-broken:2"})
+    assert code == 422
+    assert "speclint" in body["error"]
+    codes = {d["code"] for d in body["diagnostics"]["diagnostics"]}
+    assert "STR601" in codes
+    telemetry = _req(server, "GET", "/metrics")[1]
+    assert telemetry["serve_rejected_proglint"] >= 1
     assert telemetry["serve_rejected_lint"] >= 1
 
 

@@ -6,66 +6,12 @@ minimal server validates client sequencing (PutFail advances like PutOk,
 write_once_register.rs:247-266) and the record hooks end-to-end.
 """
 
-from stateright_tpu import Expectation
-from stateright_tpu.actor import Actor, ActorModel, Network
+# The server + demo model now live in the kit itself (and back the
+# `write-once-register` speclint CLI shorthand); the tests exercise the
+# bundled factory.
 from stateright_tpu.actor.write_once_register import (
-    Get,
-    GetOk,
-    Put,
-    PutFail,
-    PutOk,
-    WORegisterClient,
-    record_invocations,
-    record_returns,
+    wo_register_model as wo_model,
 )
-from stateright_tpu.semantics import LinearizabilityTester
-from stateright_tpu.semantics.write_once_register import WORegister
-
-
-class FirstWriteWinsServer(Actor):
-    """Accepts only the first write; later writes of other values fail."""
-
-    def on_start(self, id, out):
-        return None
-
-    def on_msg(self, id, state, src, msg, out):
-        if isinstance(msg, Put):
-            if state is None or state == msg.value:
-                out.send(src, PutOk(msg.request_id))
-                return msg.value
-            out.send(src, PutFail(msg.request_id))
-            return None
-        if isinstance(msg, Get):
-            out.send(src, GetOk(msg.request_id, state))
-            return None
-        return None
-
-
-def wo_model(client_count: int):
-    return (
-        ActorModel(init_history=LinearizabilityTester(WORegister()))
-        .actor(FirstWriteWinsServer())
-        .add_actors(
-            WORegisterClient(put_count=1, server_count=1)
-            for _ in range(client_count)
-        )
-        .with_init_network(Network.new_unordered_nonduplicating())
-        .property(
-            Expectation.ALWAYS,
-            "linearizable",
-            lambda model, state: state.history.serialized_history() is not None,
-        )
-        .property(
-            Expectation.SOMETIMES,
-            "a write fails",
-            lambda model, state: any(
-                isinstance(env.msg, PutFail)
-                for env in state.network.iter_deliverable()
-            ),
-        )
-        .with_record_msg_in(record_returns)
-        .with_record_msg_out(record_invocations)
-    )
 
 
 def test_single_server_write_once_is_linearizable():
