@@ -1567,6 +1567,54 @@ def main() -> int:
             "golden_match": True,
         }
 
+    def _sec_record_overhead():
+        # --- record_overhead: live NetObs cost on the actor hot path ----------
+        # The flight recorder's acceptance bar (conformance/README.md):
+        # attaching live deployment metrics (per-actor counters, Lamport
+        # stamping feed, latency/mailbox gauges) to a recorded run must
+        # cost < 3% of recorded-event throughput. A fixed-work run (one
+        # client, max_ops bumps, retries parked far out so every op is a
+        # clean round trip) on ONE base_port (FaultPlan RNG keys embed
+        # ports, so this keeps the duplicate/delay schedule identical),
+        # best-of-3 each way; rate = trace events per handler-span
+        # second, so socket setup/teardown stays out of the measurement.
+        import tempfile as _tempfile
+
+        from examples.increment import record_counter_demo
+        from stateright_tpu.conformance import FaultPlan, load_trace
+        from stateright_tpu.obs.netobs import NetObs
+
+        ops = 400
+        plan = FaultPlan(
+            seed=5, duplicate=0.2, delay=0.1, delay_range=(0.0005, 0.002)
+        )
+        tmp = _tempfile.mkdtemp(prefix="_bench_netobs.")
+
+        def rate_once(tag, netobs):
+            path = os.path.join(tmp, f"{tag}.jsonl")
+            record_counter_demo(
+                path, duration=30.0, client_count=1, base_port=46700,
+                plan=plan, max_ops=ops, netobs=netobs,
+                retry_range=(30.0, 60.0),
+            )
+            _meta, events = load_trace(path)
+            stamps = [ev["ts"] for ev in events if ev["kind"] != "fault"]
+            span = stamps[-1] - stamps[0]
+            assert span > 0 and len(events) >= 4 * ops, (tag, len(events))
+            return len(events) / span
+
+        rate_bare = max(rate_once(f"bare{i}", False) for i in range(3))
+        rate_obs = max(rate_once(f"obs{i}", NetObs()) for i in range(3))
+        overhead = max(0.0, (1.0 - rate_obs / rate_bare) * 100.0)
+        detail["record_overhead"] = {
+            "ops": ops,
+            "rate_bare": round(rate_bare, 1),
+            "rate_netobs": round(rate_obs, 1),
+            "netobs_overhead_pct": round(overhead, 2),
+        }
+        assert overhead < 3.0, detail["record_overhead"]
+
+    section("record_overhead", _sec_record_overhead)
     section("single_copy4", _sec_single_copy4)
     section("service", _sec_service)
     section("service_durable", _sec_service_durable)

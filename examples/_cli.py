@@ -127,10 +127,13 @@ def example_main(
             "faults": _pop_flag(rest, "--faults"),
             "duration": _pop_flag(rest, "--duration"),
             "engine": _pop_flag(rest, "--engine"),
+            "base_port": _pop_flag(rest, "--base-port"),
         }
         kwargs = {k: v for k, v in kwargs.items() if v is not None}
         if "duration" in kwargs:
             kwargs["duration"] = float(kwargs["duration"])
+        if "base_port" in kwargs:
+            kwargs["base_port"] = int(kwargs["base_port"])
         supported = _supported_kwargs(spawn_info, kwargs)
         dropped = sorted(set(kwargs) - set(supported))
         if dropped:

@@ -105,15 +105,27 @@ class CounterActor(Actor):
 
 class BumpClient(Actor):
     """Bumps the counter forever: request ids 1, 2, 3, ... with a retry
-    timer re-sending the in-flight Bump (at-least-once delivery)."""
+    timer re-sending the in-flight Bump (at-least-once delivery).
+
+    `max_ops` bounds the run: after that many completed bumps the client
+    goes quiet (no further sends; the retry timer keeps re-arming but has
+    nothing to resend), which makes a recorded run's logical event
+    sequence finite and — under a duplicate/delay-only plan —
+    deterministic across engines (tests/test_netobs.py relies on this)."""
 
     RETRY = "retry"
 
-    def __init__(self, server_id, retry_range: Optional[Tuple[float, float]] = None):
+    def __init__(
+        self,
+        server_id,
+        retry_range: Optional[Tuple[float, float]] = None,
+        max_ops: Optional[int] = None,
+    ):
         from stateright_tpu.actor import model_timeout
 
         self.server_id = Id(server_id)
         self.retry_range = retry_range if retry_range is not None else model_timeout()
+        self.max_ops = max_ops
 
     def name(self) -> str:
         return "BumpClient"
@@ -129,9 +141,12 @@ class BumpClient(Actor):
             and state.awaiting is not None
             and msg.request_id == state.awaiting
         ):
+            done = state.done + 1
+            if self.max_ops is not None and done >= self.max_ops:
+                return BumpClientState(awaiting=None, done=done)
             nxt = state.awaiting + 1
             out.send(self.server_id, Bump(nxt))
-            return BumpClientState(awaiting=nxt, done=state.done + 1)
+            return BumpClientState(awaiting=nxt, done=done)
         return None  # stale/duplicate BumpOk
 
     def on_timeout(self, id: Id, state: BumpClientState, timer: Any, out: Out):
@@ -243,11 +258,19 @@ def record_counter_demo(
     engine: str = "auto",
     base_port: int = 46000,
     plan=None,
+    max_ops: Optional[int] = None,
+    netobs=None,
+    retry_range: Optional[Tuple[float, float]] = None,
 ):
     """Run the counter system on loopback UDP for `duration` seconds,
     recording a conformance trace at `path`; a `seed` injects a default
     drop/duplicate/delay fault mix. Ports ascend with model index (the
-    conformance id mapping relies on that order)."""
+    conformance id mapping relies on that order).
+
+    With `max_ops` each client stops after that many completed bumps and
+    `duration` becomes a timeout cap: the run ends as soon as every
+    client is done. `netobs` is forwarded to `spawn` (live deployment
+    metrics); `retry_range` overrides the clients' retry timer."""
     from stateright_tpu.actor.spawn import (
         json_serializer,
         make_json_deserializer,
@@ -255,11 +278,13 @@ def record_counter_demo(
     )
     from stateright_tpu.conformance import FaultPlan
 
+    if retry_range is None:
+        retry_range = (0.05, 0.1)
     ids = [Id.from_addr("127.0.0.1", base_port + i) for i in range(1 + client_count)]
     actors = [(ids[0], CounterActor())]
     for k in range(client_count):
         actors.append(
-            (ids[1 + k], BumpClient(ids[0], retry_range=(0.05, 0.1)))
+            (ids[1 + k], BumpClient(ids[0], retry_range=retry_range, max_ops=max_ops))
         )
     if plan is None and seed is not None:
         plan = FaultPlan(
@@ -274,8 +299,21 @@ def record_counter_demo(
         engine=engine,
         record=path,
         faults=plan,
+        netobs=netobs,
     )
-    time.sleep(duration)
+    if max_ops is None:
+        time.sleep(duration)
+    else:
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline:
+            if all(
+                getattr(handle.state(id), "done", 0) >= max_ops
+                for id in ids[1:]
+            ):
+                break
+            time.sleep(0.01)
+        # Let straggler duplicates/delays land so the trace is complete.
+        time.sleep(0.15)
     handle.shutdown()
     return path
 

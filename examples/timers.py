@@ -124,7 +124,12 @@ def record_timers_demo(
     )
 
     if base_port % 2 != 0:
-        raise ValueError("base_port must be even (peer choice is parity-based)")
+        raise ValueError(
+            f"base_port must be even, got {base_port}: pingers pick peers by "
+            "id parity, so each actor's port parity must equal its model-index "
+            "parity — an odd base shifts every actor onto the wrong side and "
+            "the deployment silently misbehaves"
+        )
     ids = [Id.from_addr("127.0.0.1", base_port + i) for i in range(server_count)]
     actors = [
         (
@@ -165,12 +170,14 @@ def conform_timers_trace(path: str, server_count=None, metrics=None):
     return report, None
 
 
-def spawn_info(record=None, duration=None, engine="auto"):
-    """`spawn [--record TRACE] [--duration SECS] [--engine E]`."""
+def spawn_info(record=None, duration=None, engine="auto", base_port=None):
+    """`spawn [--record TRACE] [--duration SECS] [--engine E]
+    [--base-port PORT]` (PORT must be even — see `record_timers_demo`)."""
     record_timers_demo(
         record or "/tmp/timers_trace.jsonl",
         duration=duration if duration is not None else 0.4,
         engine=engine,
+        **({} if base_port is None else {"base_port": int(base_port)}),
     )
     print(f"Recorded {record or '/tmp/timers_trace.jsonl'}")
 

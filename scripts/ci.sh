@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Thirteen stages, fail-fast:
+# Fourteen stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml) — a hard
 #      failure when $CI is set, a loud skip on dev machines without it,
 #   2. the speclint dogfood — every bundled model must analyze with zero
@@ -20,35 +20,41 @@
 #      UDP under seeded drop/duplicate/delay faults, records a trace, and
 #      the trace must conform against the actor model with ZERO
 #      divergences and yield a nonzero linearizable client history,
-#   6. a serve smoke: the run server admits a 2pc-3 check plus a batch of
+#   6. a netobs smoke: a ~1s faulted counter run on every available
+#      engine with a live NetObs attached — the live fault-kind counters
+#      must match the trace's recorded fault lines exactly, the Chrome
+#      flow events must balance 1:1 (every `s` start has its `f` finish,
+#      one pair per matched delivery), and `GET /deployment` must serve
+#      the topology + per-link edges from the recorded trace,
+#   7. a serve smoke: the run server admits a 2pc-3 check plus a batch of
 #      8 small increment checks over REST, multiplexes the batch into one
 #      fused executable, matches the golden state counts, and reports an
 #      executable-cache hit on resubmission,
-#   7. a durability smoke: a checkpointed 2pc-5 device run is stopped
+#   8. a durability smoke: a checkpointed 2pc-5 device run is stopped
 #      mid-flight, resumed from its crash-safe checkpoint to the exact
 #      golden, and a journaled run service is killed with queued jobs and
 #      restarted — every job must recover and finish,
-#   8. an observability smoke: one submitted job must yield span events
+#   9. an observability smoke: one submitted job must yield span events
 #      over the /events SSE stream, histogram _bucket series in
 #      /metrics.prom, and a Chrome-trace export that JSON-parses with
 #      matching B/E pairs,
-#   9. a perf-gate smoke: `bench.py --smoke` (tiny 2pc-5 device run)
+#  10. a perf-gate smoke: `bench.py --smoke` (tiny 2pc-5 device run)
 #      seeds a throwaway history, a parity rerun must pass the gate,
 #      and a BENCH_PERTURB_SLEEP-degraded rerun must trip it — proving
 #      `bench.py --gate` actually fails CI on a real regression,
-#  10. a pipelining smoke: a tiny run with speculative era dispatch
+#  11. a pipelining smoke: a tiny run with speculative era dispatch
 #      forced ON (many short eras) must golden-match the serial driver
 #      bit-for-bit and report a flight summary with `host_gap_pct`,
-#  11. a memory smoke: the capacity planner predicts a small run's
+#  12. a memory smoke: the capacity planner predicts a small run's
 #      footprint before dispatch, the run's memory ledger must match
 #      the live buffers' nbytes EXACTLY and the planner's prediction,
 #      and the `memory_bytes{component=...}` series must render in the
 #      Prometheus exposition,
-#  12. a space smoke: the deterministic bottom-k state sample from a
+#  13. a space smoke: the deterministic bottom-k state sample from a
 #      pipelined device run must equal the host oracle's sample
 #      EXACTLY, the profile must carry field sketches, and the
 #      `space_*` gauges must render in the Prometheus exposition,
-#  13. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#  14. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -148,6 +154,70 @@ assert tester.serialized_history() is not None and len(tester) > 0, (
     "expected a nonzero linearizable client history"
 )
 print(f"conformance smoke OK: {report.steps} steps, {len(tester)} history ops")
+PY
+
+echo "== netobs smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import collections
+import json
+import os
+import tempfile
+import urllib.request
+
+from examples.increment import counter_model, record_counter_demo
+from stateright_tpu.conformance import load_trace
+from stateright_tpu.explorer.server import serve
+from stateright_tpu.native import runtime as native_runtime
+from stateright_tpu.obs.netobs import NetObs, assign_lamport, export_chrome_trace
+
+tmp = tempfile.mkdtemp(prefix="_netobs_smoke.")
+engines = ["python"] + (["native"] if native_runtime.is_available() else [])
+for i, engine in enumerate(engines):
+    path = os.path.join(tmp, f"{engine}.jsonl")
+    nob = NetObs()
+    # ~1s faulted counter run, live-instrumented on both engines.
+    record_counter_demo(
+        path, duration=1.0, seed=7, base_port=46600 + 10 * i,
+        client_count=2, engine=engine, netobs=nob,
+    )
+    meta, events = load_trace(path)
+    assert meta["v"] == 2 and meta["faults"]["seed"] == 7, meta.get("faults")
+
+    # Live fault counters must match the trace's recorded fault lines.
+    recorded = collections.Counter(
+        ev["fault"] for ev in events if ev["kind"] == "fault"
+    )
+    live = nob.snapshot().get("fault_injected", {})
+    assert dict(recorded) == live, (engine, dict(recorded), live)
+    assert recorded, "seeded plan injected no faults"
+
+    # Chrome flow events must balance: every s has its f, 1:1 by id.
+    out = os.path.join(tmp, f"{engine}.chrome.json")
+    pairs = export_chrome_trace((meta, events), out)
+    records = json.load(open(out))
+    starts = {r["id"] for r in records if r.get("ph") == "s"}
+    finishes = {r["id"] for r in records if r.get("ph") == "f"}
+    assert starts == finishes and len(starts) == pairs, (engine, pairs)
+    matched = sum(
+        1 for ev in assign_lamport(events)
+        if ev["kind"] == "deliver" and "sent_by" in ev
+    )
+    assert pairs == matched, (engine, pairs, matched)
+    print(f"  {engine}: {sum(recorded.values())} faults, {pairs} flow pairs")
+
+# GET /deployment must serve topology + edges from the recorded trace.
+server = serve(
+    counter_model(2).checker(), "127.0.0.1:0", block=False,
+    trace=os.path.join(tmp, "python.jsonl"),
+)
+try:
+    body = json.loads(
+        urllib.request.urlopen(server.url.rstrip("/") + "/deployment").read()
+    )
+    assert body["actors"] and body["edges"] and body["tail"], body.keys()
+finally:
+    server.shutdown()
+print(f"netobs smoke OK: {len(engines)} engines, /deployment serves")
 PY
 
 echo "== serve smoke =="

@@ -110,6 +110,7 @@ class _ActorLoop:
         index: int = 0,
         recorder=None,
         injector=None,
+        netobs=None,
     ):
         self.id = Id(id)
         self.actor = actor
@@ -119,6 +120,7 @@ class _ActorLoop:
         self.index = index
         self.recorder = recorder  # conformance.TraceRecorder or None
         self.injector = injector  # conformance.FaultInjector or None
+        self.netobs = netobs  # obs.netobs.NetObs or None
         # interrupt key -> absolute deadline; keys are ("t", timer) / ("r", random)
         self.next_interrupts: Dict[Any, float] = {}
         self.state: Any = None
@@ -129,6 +131,8 @@ class _ActorLoop:
     def _raw_send(self, payload: bytes, addr) -> None:
         try:
             self.sock.sendto(payload, addr)
+            if self.netobs is not None:
+                self.netobs.transmit()
         except OSError as e:
             log.warning(
                 "actor %s: sendto %s failed: %s", self.id, addr, e
@@ -139,6 +143,8 @@ class _ActorLoop:
 
         now = time.monotonic()
         if isinstance(cmd, Send):
+            if self.netobs is not None:
+                self.netobs.command(self.index, "send")
             try:
                 payload = self.serialize(cmd.msg)
             except Exception as e:
@@ -163,6 +169,8 @@ class _ActorLoop:
             else:
                 self._raw_send(payload, addr)
         elif isinstance(cmd, SetTimer):
+            if self.netobs is not None:
+                self.netobs.command(self.index, "timer_set")
             lo, hi = cmd.duration
             duration = _random.uniform(lo, hi) if lo < hi else lo
             self.next_interrupts[("t", cmd.timer)] = now + duration
@@ -182,17 +190,22 @@ class _ActorLoop:
         for cmd in out.commands:
             self._on_command(cmd)
 
-    def _record(self, kind: str, out: Out, **fields) -> None:
+    def _record(self, kind: str, out: Out, duration=None, **fields) -> None:
         # Recording precedes _dispatch so command events hit the trace
         # before the wire: an actor's `send` line is causally ordered
         # before the receiver's `deliver` line.
+        if self.netobs is not None:
+            self.netobs.handler(self.index, kind, duration)
         if self.recorder is not None:
-            self.recorder.record_handler(self.index, kind, self.state, out, **fields)
+            self.recorder.record_handler(
+                self.index, kind, self.state, out, duration=duration, **fields
+            )
 
     def run(self) -> None:
         out = Out()
+        t0 = time.monotonic()
         self.state = self.actor.on_start(self.id, out)
-        self._record("init", out)
+        self._record("init", out, duration=time.monotonic() - t0)
         self._dispatch(out)
 
         while not self.stop.is_set():
@@ -217,21 +230,25 @@ class _ActorLoop:
                 except Exception:
                     continue  # unparseable: ignore (spawn.rs:123-127)
                 src = Id.from_addr(*src_addr)
+                t0 = time.monotonic()
                 returned = self.actor.on_msg(self.id, self.state, src, msg, out)
+                dur = time.monotonic() - t0
                 event = ("deliver", {"src": int(src), "msg": msg})
             else:
                 del self.next_interrupts[min_key]  # interrupt consumed
                 kind, payload = min_key
+                t0 = time.monotonic()
                 if kind == "t":
                     returned = self.actor.on_timeout(self.id, self.state, payload, out)
                     event = ("timeout", {"timer": payload})
                 else:
                     returned = self.actor.on_random(self.id, self.state, payload, out)
                     event = ("random", {"value": payload})
+                dur = time.monotonic() - t0
 
             if returned is not None:
                 self.state = returned
-            self._record(event[0], out, **event[1])
+            self._record(event[0], out, duration=dur, **event[1])
             self._dispatch(out)
 
         self.sock.close()
@@ -245,6 +262,7 @@ def spawn(
     engine: str = "auto",
     record=None,
     faults=None,
+    netobs=None,
 ) -> "SpawnHandle":
     """Run each actor on its own thread with a UDP socket.
 
@@ -262,6 +280,11 @@ def spawn(
     ``"SEED[,drop[,dup[,delay[,reorder]]]]"`` spec string, or
     `FaultInjector`) fuzzes outgoing datagrams with a seeded
     deterministic schedule. Both work identically on either engine.
+
+    `netobs` turns on live deployment metrics (`obs.netobs.NetObs`):
+    ``True``/a `NetObs` enables them, ``False`` disables, and ``None``
+    (the default) enables them whenever the run is recorded or faulted.
+    Read the registry via ``handle.telemetry()``.
     """
     recorder = injector = None
     if record is not None or faults is not None:
@@ -270,6 +293,14 @@ def spawn(
 
         recorder = as_recorder(record)
         injector = as_injector(faults)
+    from ..obs.netobs import as_netobs
+
+    nob = as_netobs(netobs, default=recorder is not None or injector is not None)
+    if nob is not None:
+        if recorder is not None and recorder.netobs is None:
+            recorder.netobs = nob
+        if injector is not None and injector.netobs is None:
+            injector.netobs = nob
 
     resolved: List[Tuple[Id, Actor]] = []
     for id_or_addr, actor in actors:
@@ -288,6 +319,7 @@ def spawn(
                 background,
                 recorder=recorder,
                 injector=injector,
+                netobs=nob,
             )
         if engine == "native":
             raise RuntimeError(
@@ -296,12 +328,17 @@ def spawn(
             )
 
     if recorder is not None:
-        recorder.attach(resolved, engine="python")
+        recorder.attach(
+            resolved, engine="python",
+            plan=injector.plan if injector is not None else None,
+        )
+    if nob is not None:
+        nob.attach(resolved, "python")
     stop = threading.Event()
     loops = [
         _ActorLoop(
             id, actor, serialize, deserialize, stop,
-            index=i, recorder=recorder, injector=injector,
+            index=i, recorder=recorder, injector=injector, netobs=nob,
         )
         for i, (id, actor) in enumerate(resolved)
     ]
@@ -311,7 +348,9 @@ def spawn(
     ]
     for t in threads:
         t.start()
-    handle = SpawnHandle(stop, threads, loops, recorder=recorder, injector=injector)
+    handle = SpawnHandle(
+        stop, threads, loops, recorder=recorder, injector=injector, netobs=nob
+    )
     if not background:
         try:
             while any(t.is_alive() for t in threads):
@@ -332,12 +371,20 @@ def _native_runtime():
 class SpawnHandle:
     """Controls a running actor deployment (background mode)."""
 
-    def __init__(self, stop: threading.Event, threads, loops, recorder=None, injector=None):
+    def __init__(
+        self, stop: threading.Event, threads, loops,
+        recorder=None, injector=None, netobs=None,
+    ):
         self._stop = stop
         self._threads = threads
         self._loops = loops
         self._recorder = recorder
         self._injector = injector
+        self.netobs = netobs
+
+    def telemetry(self):
+        """Snapshot of the deployment's live metrics ({} when netobs is off)."""
+        return self.netobs.snapshot() if self.netobs is not None else {}
 
     def state(self, id) -> Any:
         """Peek at an actor's current state (for tests/debugging)."""

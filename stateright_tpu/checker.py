@@ -394,14 +394,16 @@ class CheckerBuilder:
 
         return ShardedBfsChecker(self, **kw)
 
-    def serve(self, address: str, trace=None):
+    def serve(self, address: str, trace=None, deployment=None):
         """Start the Explorer web service. Reference: checker.rs:144-151.
 
         `trace` attaches a recorded conformance trace (a JSONL path from
-        `spawn(..., record=...)`), served at ``GET /trace``."""
+        `spawn(..., record=...)`), served at ``GET /trace``; `deployment`
+        attaches a live spawn handle whose netobs telemetry feeds
+        ``GET /deployment``."""
         from .explorer.server import serve
 
-        return serve(self, address, trace=trace)
+        return serve(self, address, trace=trace, deployment=deployment)
 
 
 class Checker:

@@ -17,7 +17,7 @@ from .check import ConformanceReport, Divergence, check_trace
 from .events import TraceError, jsonable, load_trace, make_decoder
 from .faults import FaultDecision, FaultInjector, FaultPlan, as_injector
 from .history import extract_history, register_history
-from .record import TraceRecorder, as_recorder
+from .record import TRACE_VERSION, TraceRecorder, as_recorder
 
 __all__ = [
     "ConformanceReport",
@@ -25,6 +25,7 @@ __all__ = [
     "FaultDecision",
     "FaultInjector",
     "FaultPlan",
+    "TRACE_VERSION",
     "TraceError",
     "TraceRecorder",
     "as_injector",

@@ -45,6 +45,7 @@ from ..actor.base import Out, is_no_op
 from ..actor.ids import Id
 from ..actor.model import Deliver, SelectRandom, Timeout
 from ..obs.metrics import MetricsRegistry
+from ..obs.netobs import assign_lamport, causal_past, format_event
 from ..path import Path
 from .events import TraceError, command_views, jsonable, load_trace
 
@@ -59,11 +60,16 @@ class Divergence:
     message: str
     diff: Dict[str, list] = dataclasses.field(default_factory=dict)
     narrative: str = ""
+    causal_past: List[str] = dataclasses.field(default_factory=list)
 
     def format(self) -> str:
         lines = [f"[{self.kind}] actor={self.actor} seq={self.seq}: {self.message}"]
         for field, pair in self.diff.items():
             lines.append(f"    {field}: model={pair[0]!r} trace={pair[1]!r}")
+        if self.causal_past:
+            lines.append("    causal past (events that happened-before this one):")
+            for ln in self.causal_past:
+                lines.append(f"      {ln}")
         if self.narrative:
             lines.append("    model-side steps leading here:")
             for ln in self.narrative.rstrip("\n").splitlines():
@@ -152,11 +158,25 @@ def check_trace(
     for ev in events:
         if "cause" in ev:
             children.setdefault((ev["actor"], ev["cause"]), []).append(ev)
+    # Deterministic Lamport stamping (netobs recomputes even on v2 traces,
+    # so a hand-edited or v1 trace still gets a causal past).
+    stamped = assign_lamport(events)
 
     def diverge(kind, ev, message, diff=None, narrative=""):
         if len(report.divergences) >= max_divergences:
             report.truncated = True
             return
+        past: List[str] = []
+        if "actor" in ev and "seq" in ev:
+            try:
+                past = [
+                    format_event(p)
+                    for p in causal_past(
+                        stamped, ev["actor"], ev["seq"], k=keep_steps
+                    )
+                ]
+            except Exception:
+                past = []
         report.divergences.append(
             Divergence(
                 kind=kind,
@@ -165,6 +185,7 @@ def check_trace(
                 message=message,
                 diff=diff or {},
                 narrative=narrative,
+                causal_past=past,
             )
         )
 
@@ -205,6 +226,7 @@ def check_trace(
         kind = ev["kind"]
         if kind == "fault":
             report.faults += 1
+            metrics.inc_labeled("conformance_fault_kinds", ev.get("fault", "?"))
             continue
         if "cause" in ev:  # command child; handled with its parent
             continue

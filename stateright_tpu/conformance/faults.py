@@ -102,12 +102,28 @@ class FaultPlan:
             reorder=probs[3],
         )
 
+    @classmethod
+    def from_meta(cls, meta: dict) -> "FaultPlan":
+        """Rebuild the plan a recorded trace ran under, from the schema-v2
+        meta line's ``faults`` object — together with the fault lines'
+        ``seed_key``s this makes a schedule replayable from the trace
+        alone. Raises `ValueError` when the trace recorded no plan."""
+        spec = meta.get("faults")
+        if not isinstance(spec, dict):
+            raise ValueError("trace meta carries no fault plan")
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in spec.items() if k in fields}
+        if "delay_range" in kwargs:
+            kwargs["delay_range"] = tuple(kwargs["delay_range"])
+        return cls(**kwargs)
+
 
 class FaultInjector:
     """Applies a `FaultPlan` to a deployment's outgoing datagrams."""
 
-    def __init__(self, plan: FaultPlan):
+    def __init__(self, plan: FaultPlan, netobs=None):
         self.plan = plan
+        self.netobs = netobs  # obs.netobs.NetObs or None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._counters: Dict[Tuple[int, int], int] = {}
@@ -137,18 +153,21 @@ class FaultInjector:
             n = self._counters.get(link, 0)
             self._counters[link] = n + 1
         decision = self.plan.decide(link[0], link[1], n)
-        if (
-            decision.kind != "deliver"
-            and recorder is not None
-            and actor_index is not None
-        ):
-            recorder.record_fault(
-                actor_index,
-                decision.kind,
-                dst,
-                n,
-                delay=decision.delay if decision.kind == "delay" else None,
-            )
+        if decision.kind != "deliver":
+            # Counted and recorded at *injection* time, not check time: the
+            # live fault_injected{kind=...} series and the trace's fault
+            # line exist the moment the injector acts.
+            if self.netobs is not None:
+                self.netobs.fault(decision.kind)
+            if recorder is not None and actor_index is not None:
+                recorder.record_fault(
+                    actor_index,
+                    decision.kind,
+                    dst,
+                    n,
+                    delay=decision.delay if decision.kind == "delay" else None,
+                    seed_key=f"{self.plan.seed}|{link[0]}|{link[1]}|{n}",
+                )
         if decision.kind == "reorder":
             with self._cond:
                 if self._closed:

@@ -19,40 +19,70 @@ are adjacent in the file and the trace is causally ordered: an actor's
 
 Sequence numbers are per-actor and monotonic from 0; command events
 consume sequence numbers too and name their parent via ``cause``.
+
+Schema v2 (v1 traces still load — the stamps below are additive):
+
+  - every handler/command event carries a per-actor Lamport clock ``lc``
+    (commands tick the clock; a deliver takes ``max(local, send lc) + 1``);
+  - a matched ``deliver`` names its send as ``sent_by: [actor, seq]``
+    (duplicated datagrams re-match the consumed send, ``redelivery``);
+  - handler events carry ``dur`` (handler execution seconds) when the
+    engine measured it;
+  - the meta line carries the deployment's ``faults`` plan (seed +
+    probabilities) when an injector was attached, so a fault schedule is
+    replayable from the trace alone.
+
+The send/deliver matching here is the same FIFO-per-(src, dst, msg-key)
+discipline `obs.netobs.assign_lamport` replays offline; the recorder
+additionally feeds delivery latency and per-actor in-flight depth into
+the deployment's `NetObs` when one is attached.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from .events import command_views, jsonable
+
+TRACE_VERSION = 2
 
 
 class TraceRecorder:
     """Records one deployment's events as JSONL (see conformance/README.md)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, netobs=None):
         self.path = os.fspath(path)
+        self.netobs = netobs  # obs.netobs.NetObs or None
         self._lock = threading.Lock()
         self._f = open(self.path, "w", encoding="utf-8")
         self._seqs: List[int] = []
+        self._clocks: List[int] = []
         self._id_map: Dict[int, int] = {}
         self._attached = False
+        # FIFO of recorded-but-undelivered sends per (src, dst, msg) key,
+        # the consumed entry kept for duplicate re-matching, and per-actor
+        # in-flight depth (sends addressed to it, not yet delivered).
+        self._pending: Dict[tuple, deque] = {}
+        self._consumed: Dict[tuple, dict] = {}
+        self._outstanding: Dict[int, int] = {}
 
     # -- engine hooks --------------------------------------------------------
 
-    def attach(self, actors, engine: str) -> None:
+    def attach(self, actors, engine: str, plan=None) -> None:
         """Register the deployment roster: `actors` is the spawn-resolved
-        list of (Id, Actor) pairs, in model-index order."""
+        list of (Id, Actor) pairs, in model-index order. `plan` is the
+        deployment's `FaultPlan`, recorded in the meta line when given."""
         roster = []
         for index, (id, actor) in enumerate(actors):
             self._id_map[int(id)] = index
             ip = int(id) >> 16
-            addr = ".".join(str((ip >> s) & 0xFF for s in (24, 16, 8, 0)))
+            addr = ".".join(str((ip >> s) & 0xFF) for s in (24, 16, 8, 0))
             roster.append(
                 {
                     "index": index,
@@ -62,16 +92,18 @@ class TraceRecorder:
                 }
             )
         self._seqs = [0] * len(roster)
+        self._clocks = [0] * len(roster)
         self._attached = True
-        self._write(
-            {
-                "kind": "meta",
-                "v": 1,
-                "engine": engine,
-                "ts": time.time(),
-                "actors": roster,
-            }
-        )
+        meta: Dict[str, Any] = {
+            "kind": "meta",
+            "v": TRACE_VERSION,
+            "engine": engine,
+            "ts": time.time(),
+            "actors": roster,
+        }
+        if plan is not None:
+            meta["faults"] = dataclasses.asdict(plan)
+        self._write(meta)
 
     def record_handler(
         self,
@@ -84,6 +116,7 @@ class TraceRecorder:
         msg: Any = None,
         timer: Any = None,
         value: Any = None,
+        duration: Optional[float] = None,
     ) -> None:
         """One handler execution: `kind` is init/deliver/timeout/random,
         `state` the post-handler actor state, `out` the handler's Out."""
@@ -101,24 +134,59 @@ class TraceRecorder:
             main["timer"] = jsonable(timer, self._id_map)
         elif kind == "random":
             main["value"] = jsonable(value, self._id_map)
+        if duration is not None:
+            main["dur"] = round(float(duration), 6)
         children = command_views(out.commands, self._id_map) if out else []
+        latency: Optional[float] = None
+        outstanding: Optional[Dict[int, int]] = None
         with self._lock:
             if self._f.closed:
                 return
             seq = self._next_seq(index)
             main["seq"] = seq
+            entry = None
+            if kind == "deliver":
+                key = (main["src"], index, json.dumps(main["msg"], sort_keys=True))
+                queue = self._pending.get(key)
+                if queue:
+                    entry = queue.popleft()
+                    self._consumed[key] = entry
+                    self._outstanding[index] = self._outstanding.get(index, 0) - 1
+                    latency = now - entry["ts"]
+                else:
+                    entry = self._consumed.get(key)
+                    if entry is not None:
+                        main["redelivery"] = True
+            if entry is not None:
+                lc = max(self._clock(index), entry["lc"]) + 1
+                main["sent_by"] = [entry["actor"], entry["seq"]]
+            else:
+                lc = self._clock(index) + 1
+            self._clocks[index] = lc
+            main["lc"] = lc
             self._write_locked(main)
             for view in children:
+                lc = self._clock(index) + 1
+                self._clocks[index] = lc
                 child: Dict[str, Any] = {
                     "kind": view[0],
                     "actor": index,
                     "seq": self._next_seq(index),
                     "cause": seq,
                     "ts": now,
+                    "lc": lc,
                 }
                 if view[0] == "send":
                     child["dst"] = view[1]
                     child["msg"] = view[2]
+                    key = (index, view[1], json.dumps(view[2], sort_keys=True))
+                    self._pending.setdefault(key, deque()).append(
+                        {"actor": index, "seq": child["seq"], "lc": lc, "ts": now}
+                    )
+                    if isinstance(view[1], int):
+                        self._outstanding[view[1]] = (
+                            self._outstanding.get(view[1], 0) + 1
+                        )
                 elif view[0] in ("timer_set", "timer_cancel"):
                     child["timer"] = view[1]
                 elif view[0] == "choose":
@@ -126,6 +194,15 @@ class TraceRecorder:
                     child["choices"] = view[2]
                 self._write_locked(child)
             self._f.flush()
+            if self.netobs is not None:
+                outstanding = {
+                    k: v for k, v in self._outstanding.items() if v > 0
+                }
+        if self.netobs is not None:
+            if latency is not None:
+                self.netobs.latency(latency)
+            if outstanding is not None:
+                self.netobs.mailbox(outstanding)
 
     def record_fault(
         self,
@@ -134,9 +211,12 @@ class TraceRecorder:
         dst: int,
         link_seq: int,
         delay: Optional[float] = None,
+        seed_key: Optional[str] = None,
     ) -> None:
         """One fault-injector decision on the `index` actor's outgoing link
-        to `dst` (the link's `link_seq`-th datagram)."""
+        to `dst` (the link's `link_seq`-th datagram). `seed_key` is the
+        injector's per-(src, dst, n) RNG key, recorded so the schedule is
+        replayable from the trace alone."""
         record: Dict[str, Any] = {
             "kind": "fault",
             "actor": index,
@@ -147,6 +227,8 @@ class TraceRecorder:
         }
         if delay is not None:
             record["delay"] = round(float(delay), 6)
+        if seed_key is not None:
+            record["seed_key"] = seed_key
         self._write(record)
 
     def close(self) -> None:
@@ -166,6 +248,11 @@ class TraceRecorder:
         seq = self._seqs[index]
         self._seqs[index] = seq + 1
         return seq
+
+    def _clock(self, index: int) -> int:
+        while index >= len(self._clocks):  # defensive vs. late attach
+            self._clocks.append(0)
+        return self._clocks[index]
 
     def _write(self, record: dict) -> None:
         with self._lock:

@@ -376,6 +376,24 @@ def _trace_view(trace_path: Optional[str], query: str = "") -> Dict:
     }
 
 
+def _deployment_view(trace_path, handle, query: str = "") -> Dict:
+    """GET /deployment (alias /.deployment): the live deployment panel —
+    actor topology, per-edge delivery/fault counts, live telemetry from a
+    running deployment's `NetObs` (when the Explorer holds a spawn
+    handle), and a formatted tail of the trace's most recent events.
+    ``?tail=N`` sizes the event tail (default 40)."""
+    from ..obs.netobs import deployment_view
+
+    tail = 40
+    for part in query.split("&"):
+        if part.startswith("tail="):
+            try:
+                tail = max(0, int(part[len("tail"):].lstrip("=")))
+            except ValueError:
+                pass
+    return deployment_view(trace_path=trace_path, handle=handle, tail=tail)
+
+
 def explain_view(checker: Checker, fingerprints_path: str) -> Dict:
     """Handler for GET /.explain/... (testable without a socket):
     counterexample forensics for the fingerprint path — the per-step
@@ -495,9 +513,11 @@ def states_views(checker: Checker, fingerprints_path: str) -> List[Dict]:
 class ExplorerServer:
     """A running Explorer; `serve()` constructs it."""
 
-    def __init__(self, builder: CheckerBuilder, address: str, trace: Optional[str] = None):
+    def __init__(self, builder: CheckerBuilder, address: str, trace: Optional[str] = None,
+                 deployment=None):
         self.snapshot = _Snapshot()
         self.trace_path = trace  # recorded conformance trace to serve, if any
+        self.deployment = deployment  # live SpawnHandle for GET /deployment
         builder.visitor(self.snapshot.visit)
         # Attach a span recorder (unless the caller brought their own) so
         # the on-demand engine's run/progress spans feed GET /events.
@@ -548,6 +568,15 @@ class ExplorerServer:
                 elif path in ("/trace", "/.trace"):
                     try:
                         self._send_json(_trace_view(explorer.trace_path, query))
+                    except KeyError as e:
+                        self._send(404, str(e).encode(), "text/plain")
+                elif path in ("/deployment", "/.deployment"):
+                    try:
+                        self._send_json(
+                            _deployment_view(
+                                explorer.trace_path, explorer.deployment, query
+                            )
+                        )
                     except KeyError as e:
                         self._send(404, str(e).encode(), "text/plain")
                 elif path.startswith("/.explain"):
@@ -621,14 +650,16 @@ class ExplorerServer:
 
 
 def serve(builder: CheckerBuilder, address: str, block: bool = True,
-          trace: Optional[str] = None):
+          trace: Optional[str] = None, deployment=None):
     """Start the Explorer. Reference: serve() (explorer.rs:79-99).
 
     With `block=False` the server runs on daemon threads and the handle is
     returned (a testability capability the reference lacks). `trace`
-    attaches a recorded conformance trace, served at ``GET /trace``.
+    attaches a recorded conformance trace, served at ``GET /trace``;
+    `deployment` attaches a live spawn handle whose netobs telemetry
+    feeds ``GET /deployment``.
     """
-    server = ExplorerServer(builder, address, trace=trace)
+    server = ExplorerServer(builder, address, trace=trace, deployment=deployment)
     if block:
         server.serve_forever()
         return server.checker

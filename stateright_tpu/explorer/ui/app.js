@@ -525,6 +525,67 @@ async function pollSpace() {
   setTimeout(pollSpace, 2000);
 }
 
+// ---- deployment panel ------------------------------------------------------
+// Polls /deployment every 2s: per-link delivery/fault counts from the
+// attached conformance trace (and live netobs telemetry when the
+// Explorer holds a spawn handle) as rows, plus the causal event tail.
+
+function renderDeployEdges(edges) {
+  const holder = $("deploy-edges");
+  holder.innerHTML = "";
+  const max = Math.max(...edges.map((e) => e.delivered || 0), 1);
+  for (const e of edges) {
+    const row = document.createElement("div");
+    row.className = "cov-row";
+    const name = document.createElement("span");
+    name.className = "cov-label";
+    name.textContent = `${e.src} \u2192 ${e.dst}`;
+    const track = document.createElement("span");
+    track.className = "cov-track";
+    const bar = document.createElement("span");
+    bar.className = "cov-bar";
+    bar.style.width =
+      Math.max(1, ((e.delivered || 0) / max) * 100).toFixed(1) + "%";
+    track.appendChild(bar);
+    const val = document.createElement("span");
+    val.className = "cov-count";
+    const faults = Object.entries(e.faults || {})
+      .map(([k, n]) => `${k} ${n}`)
+      .join(" ");
+    val.textContent =
+      `${e.sent || 0} sent · ${e.delivered || 0} delivered` +
+      (faults ? ` · ${faults}` : "");
+    row.appendChild(name);
+    row.appendChild(track);
+    row.appendChild(val);
+    holder.appendChild(row);
+  }
+}
+
+async function pollDeployment() {
+  try {
+    const res = await fetch("/deployment");
+    if (!res.ok) throw new Error("no deployment");
+    const body = await res.json();
+    const actors = body.actors || [];
+    if (actors.length) {
+      $("deployment-panel").hidden = false;
+      renderDeployEdges(body.edges || {});
+      const bits = [
+        `${actors.length} actors`,
+        `${body.events || 0} events`,
+      ];
+      if (body.engine) bits.push(`engine ${body.engine}`);
+      if (body.faults_plan) bits.push(`fault seed ${body.faults_plan.seed}`);
+      $("deploy-readout").textContent = bits.join(" · ");
+      $("deploy-tail").textContent = (body.tail || []).join("\n");
+    }
+  } catch (e) {
+    /* deployment endpoint unavailable: leave the panel hidden */
+  }
+  setTimeout(pollDeployment, 2000);
+}
+
 // ---- span waterfall (run ledger) -------------------------------------------
 // Span completions arrive live over GET /events (SSE, obs/spans.py). The
 // waterfall draws the most recent trace's spans as horizontal bars on a
@@ -735,5 +796,6 @@ pollCoverage();
 pollFlight();
 pollMemory();
 pollSpace();
+pollDeployment();
 startSpanStream();
 loadStates();

@@ -13,6 +13,7 @@ import logging
 import os
 import random as _random
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..actor.base import Actor, CancelTimer, ChooseRandom, Out, Send, SetTimer
@@ -122,7 +123,7 @@ class NativeSpawnHandle:
     """Controls a running native deployment; mirrors spawn.SpawnHandle."""
 
     def __init__(self, lib, handle: int, shims: List[_ActorShim], cb_ref,
-                 recorder=None, injector=None):
+                 recorder=None, injector=None, netobs=None):
         self._lib = lib
         self._handle = handle
         self._shims = shims
@@ -130,6 +131,11 @@ class NativeSpawnHandle:
         self._stopped = threading.Event()
         self._recorder = recorder
         self._injector = injector
+        self.netobs = netobs
+
+    def telemetry(self):
+        """Snapshot of the deployment's live metrics ({} when netobs is off)."""
+        return self.netobs.snapshot() if self.netobs is not None else {}
 
     def state(self, id) -> Any:
         for shim in self._shims:
@@ -156,6 +162,7 @@ def spawn(
     background: bool = False,
     recorder=None,
     injector=None,
+    netobs=None,
 ) -> NativeSpawnHandle:
     """Run the actor system on the native core. Reference: spawn.rs:64-154.
 
@@ -168,7 +175,12 @@ def spawn(
 
     shims = [_ActorShim(i, id, actor) for i, (id, actor) in enumerate(actors)]
     if recorder is not None:
-        recorder.attach(actors, engine="native")
+        recorder.attach(
+            actors, engine="native",
+            plan=injector.plan if injector is not None else None,
+        )
+    if netobs is not None:
+        netobs.attach(actors, "native")
     handle_box: List[int] = []
     # Native threads can deliver on_start before srn_start returns on this
     # thread; events hold until the handle is published (Event.wait releases
@@ -178,6 +190,8 @@ def spawn(
     def dispatch(shim: _ActorShim, out: Out) -> None:
         for cmd in out.commands:
             if isinstance(cmd, Send):
+                if netobs is not None:
+                    netobs.command(shim.index, "send")
                 try:
                     payload = serialize(cmd.msg)
                 except Exception as e:
@@ -191,6 +205,8 @@ def spawn(
                 def wire_send(data, _ip=_ip_to_u32(ip), _port=port, _index=shim.index):
                     buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
                     lib.srn_send(handle_box[0], _index, _ip, _port, buf, len(data))
+                    if netobs is not None:
+                        netobs.transmit()
 
                 if injector is not None:
                     injector.transmit(
@@ -200,6 +216,8 @@ def spawn(
                 else:
                     wire_send(payload)
             elif isinstance(cmd, SetTimer):
+                if netobs is not None:
+                    netobs.command(shim.index, "timer_set")
                 lo, hi = cmd.duration
                 delay = _random.uniform(lo, hi) if lo < hi else lo
                 key = shim.intern(("t", cmd.timer))
@@ -225,9 +243,15 @@ def spawn(
         out = Out()
         try:
             if kind == 0:  # start
+                t0 = time.monotonic()
                 shim.state = shim.actor.on_start(shim.id, out)
+                dur = time.monotonic() - t0
+                if netobs is not None:
+                    netobs.handler(shim.index, "init", dur)
                 if recorder is not None:
-                    recorder.record_handler(shim.index, "init", shim.state, out)
+                    recorder.record_handler(
+                        shim.index, "init", shim.state, out, duration=dur
+                    )
             elif kind == 1:  # datagram
                 payload = bytes(
                     ctypes.cast(
@@ -242,21 +266,26 @@ def spawn(
                     str((src_ip >> s) & 0xFF) for s in (24, 16, 8, 0)
                 )
                 src = Id.from_addr(ip, src_port)
+                t0 = time.monotonic()
                 returned = shim.actor.on_msg(
                     shim.id, shim.state, src, msg, out
                 )
+                dur = time.monotonic() - t0
                 if returned is not None:
                     shim.state = returned
+                if netobs is not None:
+                    netobs.handler(shim.index, "deliver", dur)
                 if recorder is not None:
                     recorder.record_handler(
                         shim.index, "deliver", shim.state, out,
-                        src=int(src), msg=msg,
+                        src=int(src), msg=msg, duration=dur,
                     )
             else:  # deadline
                 obj = shim.obj_of.get(int(key))
                 if obj is None:
                     return
                 k, payload_obj = obj
+                t0 = time.monotonic()
                 if k == "t":
                     returned = shim.actor.on_timeout(
                         shim.id, shim.state, payload_obj, out
@@ -265,18 +294,23 @@ def spawn(
                     returned = shim.actor.on_random(
                         shim.id, shim.state, payload_obj, out
                     )
+                dur = time.monotonic() - t0
                 if returned is not None:
                     shim.state = returned
+                if netobs is not None:
+                    netobs.handler(
+                        shim.index, "timeout" if k == "t" else "random", dur
+                    )
                 if recorder is not None:
                     if k == "t":
                         recorder.record_handler(
                             shim.index, "timeout", shim.state, out,
-                            timer=payload_obj,
+                            timer=payload_obj, duration=dur,
                         )
                     else:
                         recorder.record_handler(
                             shim.index, "random", shim.state, out,
-                            value=payload_obj,
+                            value=payload_obj, duration=dur,
                         )
             dispatch(shim, out)
         except Exception:
@@ -295,7 +329,10 @@ def spawn(
         raise OSError(f"native spawn failed to bind actor {-1 - handle}")
     handle_box.append(handle)
     handle_ready.set()
-    h = NativeSpawnHandle(lib, handle, shims, cb, recorder=recorder, injector=injector)
+    h = NativeSpawnHandle(
+        lib, handle, shims, cb,
+        recorder=recorder, injector=injector, netobs=netobs,
+    )
     if not background:
         try:
             while True:

@@ -55,10 +55,23 @@ from .sample import (
 )
 from .metrics import (
     MEMORY_SERIES_LABELS,
+    NETOBS_SERIES_LABELS,
     SHARD_SERIES_LABELS,
     Histogram,
     MetricsRegistry,
     render_prometheus,
+)
+from .netobs import (
+    DEFAULT_CAUSAL_PAST_K,
+    NetObs,
+    as_netobs,
+    assign_lamport,
+    causal_order,
+    causal_past,
+    deployment_view,
+    export_chrome_trace,
+    flow_pairs,
+    format_event,
 )
 from .spans import SpanRecorder, attach_phase_spans, new_span_id, new_trace_id
 from .stageprof import STAGE_ORDER, stage_rows
@@ -71,6 +84,7 @@ from .trace import (
 )
 
 __all__ = [
+    "DEFAULT_CAUSAL_PAST_K",
     "DEFAULT_FLIGHT_CAPACITY",
     "DEFAULT_SAMPLE_K",
     "DEPTH_CAP",
@@ -92,12 +106,22 @@ __all__ = [
     "MemoryLedger",
     "MemoryRecorder",
     "MetricsRegistry",
+    "NETOBS_SERIES_LABELS",
+    "NetObs",
     "SHARD_SERIES_LABELS",
     "STAGE_ORDER",
     "SpanRecorder",
     "TraceWriter",
+    "as_netobs",
+    "assign_lamport",
     "attach_phase_spans",
+    "causal_order",
+    "causal_past",
+    "deployment_view",
     "device_memory_bytes",
+    "export_chrome_trace",
+    "flow_pairs",
+    "format_event",
     "format_plan",
     "get_logger",
     "make_trace_writer",

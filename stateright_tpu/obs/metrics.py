@@ -305,6 +305,23 @@ MEMORY_SERIES_LABELS = {
     "memory_bytes": "component",
 }
 
+#: Dict-valued deployment metric (obs/netobs.py NetObs) -> Prometheus
+#: label key: the per-actor and per-fault-kind series a spawned actor
+#: system populates live, rendering as e.g.
+#: ``stateright_actor_messages_sent{actor="1"} 42`` and
+#: ``stateright_fault_injected{kind="drop"} 3``. Merge alongside the
+#: other *_SERIES_LABELS wherever deployment snapshots are rendered.
+NETOBS_SERIES_LABELS = {
+    "actor_handlers": "actor",
+    "actor_messages_sent": "actor",
+    "actor_messages_delivered": "actor",
+    "actor_timer_set": "actor",
+    "actor_timer_fired": "actor",
+    "actor_mailbox_depth": "actor",
+    "fault_injected": "kind",
+    "conformance_fault_kinds": "kind",
+}
+
 
 def render_prometheus(
     snapshot: Dict[str, Any],

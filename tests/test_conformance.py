@@ -13,6 +13,7 @@ from examples.increment import conform_counter_trace, record_counter_demo
 from examples.linearizable_register import conform_abd_trace, record_abd_demo
 from examples.timers import conform_timers_trace, record_timers_demo
 from stateright_tpu.conformance import (
+    TRACE_VERSION,
     FaultInjector,
     FaultPlan,
     TraceError,
@@ -197,7 +198,7 @@ def test_trace_schema(engine, tmp_path):
     )
     lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
     meta = json.loads(lines[0])
-    assert meta["kind"] == "meta" and meta["v"] == 1
+    assert meta["kind"] == "meta" and meta["v"] == TRACE_VERSION
     assert meta["engine"] == engine
     assert [a["index"] for a in meta["actors"]] == [0, 1]
     assert meta["actors"][0]["actor"] == "CounterActor"
