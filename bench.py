@@ -410,16 +410,23 @@ def compare_bench(path_a, path_b, out=None):
 GATE_BASELINE_WINDOW = 5
 
 # Direction inference by metric-name fragment. Higher-better: throughput
-# rates and speedups. Lower-better: wall times, latency quantiles,
-# instrumentation overheads, the flight recorder's host-gap share
-# (dispatch-bound idle time the pipelining work exists to remove), era
-# counts (fewer eras = bigger mega-eras = fewer host round-trips), and
-# memory residency per unique state (ledger peak / unique — footprint
+# rates and speedups, plus the mega-dispatch gauges — `spec_chain_depth`
+# (how deep the speculative era chain actually got) and
+# `fused_eras_per_dispatch` (eras folded into each compiled dispatch;
+# checked before the lower-better "eras" fragment would claim it).
+# Lower-better: wall times, latency quantiles, instrumentation overheads,
+# the flight recorder's host-gap share (dispatch-bound idle time the
+# pipelining work exists to remove), era and dispatch counts (fewer
+# dispatches = deeper fusion = fewer host round-trips), and memory
+# residency per unique state (ledger peak / unique — footprint
 # regressions surface here). Keys matching neither stay out of the gate.
-_GATE_HIGHER = ("states_per_sec", "checks_per_sec", "per_sec", "speedup")
+_GATE_HIGHER = (
+    "states_per_sec", "checks_per_sec", "per_sec", "speedup",
+    "spec_chain_depth", "fused_eras_per_dispatch",
+)
 _GATE_LOWER = (
     "p50", "p95", "p99", "secs", "ms", "overhead_pct",
-    "host_gap_pct", "eras", "bytes_per_state",
+    "host_gap_pct", "eras", "dispatches", "bytes_per_state",
 )
 
 # Sections whose numeric leaves are environment/diagnostic detail, not
@@ -922,6 +929,40 @@ def main() -> int:
     assert flight_overhead_pct < 2.0, detail["tpc7_flight_cost"]
     assert recon_err_pct < 5.0, detail["tpc7_flight_cost"]
 
+    # Mega-dispatch: the SAME workload with the K-deep speculative chain
+    # at depth 4 and 4 eras fused per compiled dispatch. Golden must
+    # still match (the whole point: fusion is output-invisible), and the
+    # three chain gauges are gate-tracked — `dispatches` lower-better
+    # (fewer host round-trips), `spec_chain_depth` and
+    # `fused_eras_per_dispatch` higher-better (the chain actually
+    # filling / the fusion actually engaging are the perf contracts).
+    t0 = time.perf_counter()
+    mega7 = (
+        TensorModelAdapter(tm7)
+        .checker()
+        .pipeline(depth=4, fuse=4)
+        .spawn_tpu_bfs(**opts)
+        .join()
+    )
+    mega_secs = time.perf_counter() - t0
+    assert mega7.unique_state_count() == tpc7_golden
+    mtel = mega7.telemetry()
+    detail["tpc7_mega"] = {
+        "states_per_sec": round(mega7.state_count() / mega_secs, 1),
+        "secs": round(mega_secs, 3),
+        "eras": int(mtel.get("eras", 0)),
+        "dispatches": int(mtel.get("dispatches", 0)),
+        "spec_chain_depth": int(mtel.get("spec_chain_depth", 0)),
+        "fused_eras_per_dispatch": float(
+            mtel.get("fused_eras_per_dispatch", 0.0)
+        ),
+        "spec_wasted": int(mtel.get("spec_wasted", 0)),
+    }
+    if detail["tpc7_mega"]["eras"] > 1:
+        assert (
+            detail["tpc7_mega"]["dispatches"] < detail["tpc7_mega"]["eras"]
+        ), detail["tpc7_mega"]
+
     # Memory: the headline run's ledger peak (obs/memory.py), residency
     # per unique state (gate-tracked, lower-better), and the capacity
     # planner's static prediction at the same geometry vs the measured
@@ -1248,21 +1289,45 @@ def main() -> int:
 
     def _sec_paxos3():
         # --- paxos-3: the BASELINE.json north-star workload -------------------
+        # Timed at the mega-dispatch config (chain depth 4, 4 eras fused
+        # per dispatch) — this is the acceptance workload for the
+        # dispatch-gap work, so its timing row carries the chain gauges
+        # and a pure device_secs (phase_ms, host gap excluded) alongside
+        # the wall secs.
         px3 = PaxosTensorExhaustive(3)
         opts3 = dict(
             chunk_size=16384, queue_capacity=1 << 21, table_capacity=1 << 26
         )
-        TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()  # compile
+
+        def mk3():
+            return (
+                TensorModelAdapter(px3)
+                .checker()
+                .pipeline(depth=4, fuse=4)
+                .spawn_tpu_bfs(**opts3)
+            )
+
+        mk3().join()  # compile
         t0 = time.perf_counter()
-        d3 = TensorModelAdapter(px3).checker().spawn_tpu_bfs(**opts3).join()
+        d3 = mk3().join()
         secs3 = time.perf_counter() - t0
         assert d3.unique_state_count() == PAXOS3_GOLDEN, d3.unique_state_count()
+        tel3 = d3.telemetry()
         detail["paxos3"] = {
             "states_per_sec": round(d3.state_count() / secs3, 1),
             "unique": d3.unique_state_count(),
             "secs": round(secs3, 3),
+            "device_secs": round(
+                float(tel3.get("phase_ms", {}).get("device_era", 0.0)) / 1e3,
+                3,
+            ),
+            "dispatches": int(tel3.get("dispatches", 0)),
+            "spec_chain_depth": int(tel3.get("spec_chain_depth", 0)),
+            "fused_eras_per_dispatch": float(
+                tel3.get("fused_eras_per_dispatch", 0.0)
+            ),
             "golden_match": True,
-            "telemetry": d3.telemetry(),
+            "telemetry": tel3,
         }
 
     def _sec_paxos6():

@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Fourteen stages, fail-fast:
+# Fifteen stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml) — a hard
 #      failure when $CI is set, a loud skip on dev machines without it,
 #   2. the speclint dogfood — every bundled model must analyze with zero
@@ -45,16 +45,22 @@
 #  11. a pipelining smoke: a tiny run with speculative era dispatch
 #      forced ON (many short eras) must golden-match the serial driver
 #      bit-for-bit and report a flight summary with `host_gap_pct`,
-#  12. a memory smoke: the capacity planner predicts a small run's
+#  12. a mega-dispatch smoke: the same workload with the speculative
+#      chain at depth 4 AND 4 eras fused per compiled dispatch must
+#      golden-match the serial driver bit-for-bit, report strictly
+#      fewer dispatches than eras, and the stage profiler must still
+#      reconcile its per-stage breakdown with the (fused) era wall
+#      time within 10%,
+#  13. a memory smoke: the capacity planner predicts a small run's
 #      footprint before dispatch, the run's memory ledger must match
 #      the live buffers' nbytes EXACTLY and the planner's prediction,
 #      and the `memory_bytes{component=...}` series must render in the
 #      Prometheus exposition,
-#  13. a space smoke: the deterministic bottom-k state sample from a
+#  14. a space smoke: the deterministic bottom-k state sample from a
 #      pipelined device run must equal the host oracle's sample
 #      EXACTLY, the profile must carry field sketches, and the
 #      `space_*` gauges must render in the Prometheus exposition,
-#  14. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#  15. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -473,6 +479,69 @@ print(
     f"{tel['spec_dispatch']} speculative dispatches "
     f"({tel.get('spec_wasted', 0)} wasted), "
     f"host_gap_pct={fsum['host_gap_pct']}"
+)
+PY
+
+echo "== mega-dispatch smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+# Many short eras (sync_steps=4) so the K-deep chain fills and the
+# fused inner loop actually runs several eras per compiled dispatch.
+opts = dict(
+    chunk_size=64, queue_capacity=1 << 12, table_capacity=1 << 11,
+    sync_steps=4,
+)
+
+
+def fingerprint(c):
+    cov = c.coverage()
+    return (
+        c.unique_state_count(), c.state_count(), c.max_depth(),
+        dict(c._discovery_fps), cov["actions"], cov["depths"],
+        tuple(c._sampler.fingerprints()),
+    )
+
+
+def run(builder_fn):
+    b = TensorModelAdapter(TwoPhaseTensor(5)).checker().coverage().sample(k=32)
+    return builder_fn(b).spawn_tpu_bfs(**opts).join()
+
+
+serial = run(lambda b: b.pipeline(False))
+mega = run(lambda b: b.pipeline(depth=4, fuse=4))
+assert fingerprint(serial) == fingerprint(mega), (
+    "mega-dispatch run diverged from the serial driver"
+)
+assert mega.unique_state_count() == 8832, mega.unique_state_count()
+tel = mega.telemetry()
+eras, dispatches = tel["eras"], tel["dispatches"]
+assert dispatches < eras, (dispatches, eras)
+assert tel.get("fused_eras_per_dispatch", 0.0) > 1.0, tel
+assert tel.get("spec_chain_depth", 0) >= 1, tel
+
+# The stage profiler must still reconcile against the FUSED era body:
+# stage micro-benches attribute >=90% of the measured era wall time.
+prof = (
+    TensorModelAdapter(TwoPhaseTensor(5))
+    .checker()
+    .stage_profile(iters=2)
+    .pipeline(depth=4, fuse=4)
+    .spawn_tpu_bfs(**opts)
+    .join()
+)
+ptel = prof.telemetry()
+assert "stage_profile_error" not in ptel, ptel.get("stage_profile_error")
+stages = {k: v for k, v in ptel["phase_ms"].items() if k.startswith("stage_")}
+era = ptel["phase_ms"]["device_era"]
+assert era > 0 and abs(sum(stages.values()) - era) <= 0.1 * era, (stages, era)
+print(
+    f"mega-dispatch smoke OK: 8832 uniques golden-match serial in "
+    f"{dispatches} dispatches over {eras} eras "
+    f"(chain depth {tel['spec_chain_depth']}, "
+    f"{tel['fused_eras_per_dispatch']} eras/dispatch); "
+    f"{len(stages)} stages reconcile {era:.0f} ms of fused era time"
 )
 PY
 

@@ -182,6 +182,20 @@ def _trace(fn, args):
 # -- program lowering --------------------------------------------------------
 
 
+#: Representative multi-era fusion factor linted and budgeted alongside
+#: the classic single-era programs: a fused program is a DIFFERENT
+#: compiled artifact (inner while_loop + fusion tail), so it gets its own
+#: budget row — the engine key grows a ``+f{N}`` suffix (e.g.
+#: ``tpu_bfs+f4``) and two fusion factors never share a ratchet.
+FUSED_LINT_FACTOR = 4
+
+GEOMETRY_KEYS = ("chunk", "qcap", "tcap", "cov", "sample_k", "fuse")
+
+
+def _engine_key(base: str, fuse: int) -> str:
+    return base if int(fuse) <= 1 else f"{base}+f{int(fuse)}"
+
+
 def _era_geometry(tm: TensorModel) -> Dict[str, Any]:
     from ..engines.compiled import era_geometry
 
@@ -208,6 +222,7 @@ def _sharded_geometry(tm: TensorModel) -> Dict[str, Any]:
         "quota": quota,
         "cov": True,
         "sample_k": DEFAULT_SAMPLE_K,
+        "fuse": 1,
     }
 
 
@@ -215,12 +230,14 @@ def _lower_era(tm: TensorModel, g: Dict[str, Any]):
     from ..engines.tpu_bfs import _build_loop, loop_abstract_args
 
     props = tm.tensor_properties()
+    fuse = int(g.get("fuse", 1))
     loop = _build_loop(
         tm, props, g["chunk"], g["qcap"], False, g["cov"],
-        sample_k=g["sample_k"],
-    )
+        sample_k=g["sample_k"], fuse=fuse,
+    ).serial
     args = loop_abstract_args(
-        tm, props, g["chunk"], g["qcap"], g["tcap"], g["cov"], g["sample_k"]
+        tm, props, g["chunk"], g["qcap"], g["tcap"], g["cov"], g["sample_k"],
+        fuse=fuse,
     )
     return loop, args
 
@@ -229,13 +246,14 @@ def _lower_seed_loop(tm: TensorModel, g: Dict[str, Any]):
     from ..engines.tpu_bfs import _build_seed_loop, seed_loop_abstract_args
 
     props = tm.tensor_properties()
+    fuse = int(g.get("fuse", 1))
     fn = _build_seed_loop(
         tm, props, g["chunk"], g["qcap"], g["tcap"], False, g["cov"],
-        sample_k=g["sample_k"],
+        sample_k=g["sample_k"], fuse=fuse,
     )
     args = seed_loop_abstract_args(
         tm, props, g["chunk"], g["qcap"], g["tcap"], g["cov"],
-        g["sample_k"], g["n_init"],
+        g["sample_k"], g["n_init"], fuse=fuse,
     )
     return fn, args
 
@@ -305,13 +323,14 @@ def _lower_sharded(tm: TensorModel, g: Dict[str, Any]):
 
     props = tm.tensor_properties()
     mesh = Mesh(np.array(jax.devices()), ("shards",))
+    fuse = int(g.get("fuse", 1))
     fn = _build_block(
         tm, props, g["chunk"], g["qcap"], g["n_shards"], g["quota"], mesh,
-        "shards", cov=g["cov"], sample_k=g["sample_k"],
-    )
+        "shards", cov=g["cov"], sample_k=g["sample_k"], fuse=fuse,
+    ).serial
     args = block_abstract_args(
         tm, props, g["qcap"], g["tcap"], g["n_shards"], g["cov"],
-        g["sample_k"],
+        g["sample_k"], fuse=fuse,
     )
     return fn, args
 
@@ -660,15 +679,18 @@ def _analyze_programs(
     summary: Dict[str, Any] = {
         "signature": sig,
         "backend": jax.default_backend(),
-        "geometry": {k: g[k] for k in ("chunk", "qcap", "tcap", "cov", "sample_k")},
+        "geometry": {k: g[k] for k in GEOMETRY_KEYS},
         "programs": {},
     }
 
     # The era loop: the one program every run's wall clock is made of.
+    # The SERIAL program variant fully donates its operands — table (3
+    # lanes) + queue (S+2 lanes) + rec_fp1/rec_fp2 + the params vector
+    # (the readback-tail donation: serial dispatches always feed a fresh
+    # upload or a consumed buffer back in).
     donated_leaves = 0
     if donate_argnums_safe(0, 1):
-        # table (3 lanes) + queue (S+2 lanes), the donated pytrees.
-        donated_leaves = 3 + tm.state_width + 2
+        donated_leaves = 3 + (tm.state_width + 2) + 2 + 1
     era_traced = None
     try:
         loop, args = _lower_era(tm, g)
@@ -678,8 +700,8 @@ def _analyze_programs(
         _check_transfers(tm, "era_loop", prims, report)
         _check_dtypes(tm, "era_loop", dtypes, report)
         _check_budget(
-            tm, "tpu_bfs", sig, int(sum(prims.values())),
-            summary["geometry"], budgets, report,
+            tm, _engine_key("tpu_bfs", g["fuse"]), sig,
+            int(sum(prims.values())), summary["geometry"], budgets, report,
         )
         # Lowering to StableHLO text is the expensive half of this pass;
         # pay it only when donation is actually expected (the detector
@@ -719,34 +741,65 @@ def _analyze_programs(
                 _check_dtypes(tm, name, dtypes, report)
             except Exception as exc:  # noqa: BLE001
                 _trace_failed(tm, name, exc, report)
-        # The sharded block, with its own geometry and budget line.
-        sg = _sharded_geometry(tm)
+        # The FUSED era loop (mega-dispatch, engines/tpu_bfs.py): a
+        # different compiled artifact with its own budget row keyed
+        # `tpu_bfs+f{N}`.
+        gf = dict(g, fuse=FUSED_LINT_FACTOR)
         try:
-            fn, fargs = _lower_sharded(tm, sg)
-            closed, straced = _trace(fn, fargs)
+            loop, args = _lower_era(tm, gf)
+            closed, _ = _trace(loop, args)
             prims, dtypes = count_ops(closed)
-            summary["programs"]["sharded_era"] = _prog_summary(prims, dtypes)
-            summary["sharded_geometry"] = dict(sg)
-            _check_transfers(tm, "sharded_era", prims, report)
-            _check_dtypes(tm, "sharded_era", dtypes, report)
-            _check_budget(
-                tm, "sharded", sig, int(sum(prims.values())), dict(sg),
-                budgets, report,
+            summary["programs"]["era_loop_fused"] = _prog_summary(
+                prims, dtypes
             )
-            if donated_leaves > 0:
-                slow = (
-                    straced.lower() if straced is not None
-                    else fn.lower(*fargs)
-                )
-                check_donation_text(
-                    tm, "sharded_era", slow.as_text(), donated_leaves, report
-                )
-            else:
-                check_donation_text(
-                    tm, "sharded_era", "", donated_leaves, report
-                )
+            _check_transfers(tm, "era_loop_fused", prims, report)
+            _check_dtypes(tm, "era_loop_fused", dtypes, report)
+            _check_budget(
+                tm, _engine_key("tpu_bfs", gf["fuse"]), sig,
+                int(sum(prims.values())),
+                {k: gf[k] for k in GEOMETRY_KEYS}, budgets, report,
+            )
         except Exception as exc:  # noqa: BLE001
-            _trace_failed(tm, "sharded_era", exc, report)
+            _trace_failed(tm, "era_loop_fused", exc, report)
+        # The sharded block, with its own geometry and budget line. Its
+        # serial variant donates table + queue + params (rec_fps stay
+        # live for the host discovery reads).
+        sharded_donated = (
+            3 + (tm.state_width + 2) + 1 if donated_leaves > 0 else 0
+        )
+        sg = _sharded_geometry(tm)
+        for prog_name, geo in (
+            ("sharded_era", sg),
+            ("sharded_era_fused", dict(sg, fuse=FUSED_LINT_FACTOR)),
+        ):
+            try:
+                fn, fargs = _lower_sharded(tm, geo)
+                closed, straced = _trace(fn, fargs)
+                prims, dtypes = count_ops(closed)
+                summary["programs"][prog_name] = _prog_summary(prims, dtypes)
+                if prog_name == "sharded_era":
+                    summary["sharded_geometry"] = dict(geo)
+                _check_transfers(tm, prog_name, prims, report)
+                _check_dtypes(tm, prog_name, dtypes, report)
+                _check_budget(
+                    tm, _engine_key("sharded", geo["fuse"]), sig,
+                    int(sum(prims.values())), dict(geo), budgets, report,
+                )
+                if sharded_donated > 0:
+                    slow = (
+                        straced.lower() if straced is not None
+                        else fn.lower(*fargs)
+                    )
+                    check_donation_text(
+                        tm, prog_name, slow.as_text(), sharded_donated,
+                        report,
+                    )
+                else:
+                    check_donation_text(
+                        tm, prog_name, "", sharded_donated, report
+                    )
+            except Exception as exc:  # noqa: BLE001
+                _trace_failed(tm, prog_name, exc, report)
 
         if lowered is not None:
             _cost_model(tm, g, lowered, summary, report)
@@ -896,28 +949,32 @@ def write_budgets(
     entries = doc.setdefault("entries", {})
 
     g = _era_geometry(tm)
-    loop, args = _lower_era(tm, g)
-    closed, _ = _trace(loop, args)
-    prims, _dt = count_ops(closed)
-    geometry = {k: g[k] for k in ("chunk", "qcap", "tcap", "cov", "sample_k")}
-    written = {}
-    written[f"tpu_bfs|{sig}"] = {
-        "model": label,
-        "ops": int(sum(prims.values())),
-        "geometry": geometry,
-        "jax": jax.__version__,
-    }
-
     sg = _sharded_geometry(tm)
-    fn, fargs = _lower_sharded(tm, sg)
-    closed, _ = _trace(fn, fargs)
-    prims, _dt = count_ops(closed)
-    written[f"sharded|{sig}"] = {
-        "model": label,
-        "ops": int(sum(prims.values())),
-        "geometry": dict(sg),
-        "jax": jax.__version__,
-    }
+    written = {}
+    # One row per (engine, fusion factor): the fused programs are
+    # distinct compiled artifacts, so each carries its own ratchet.
+    for fuse in (1, FUSED_LINT_FACTOR):
+        gf = dict(g, fuse=fuse)
+        loop, args = _lower_era(tm, gf)
+        closed, _ = _trace(loop, args)
+        prims, _dt = count_ops(closed)
+        written[f"{_engine_key('tpu_bfs', fuse)}|{sig}"] = {
+            "model": label,
+            "ops": int(sum(prims.values())),
+            "geometry": {k: gf[k] for k in GEOMETRY_KEYS},
+            "jax": jax.__version__,
+        }
+
+        sgf = dict(sg, fuse=fuse)
+        fn, fargs = _lower_sharded(tm, sgf)
+        closed, _ = _trace(fn, fargs)
+        prims, _dt = count_ops(closed)
+        written[f"{_engine_key('sharded', fuse)}|{sig}"] = {
+            "model": label,
+            "ops": int(sum(prims.values())),
+            "geometry": dict(sgf),
+            "jax": jax.__version__,
+        }
 
     entries.update(written)
     doc["entries"] = dict(sorted(entries.items()))

@@ -66,6 +66,8 @@ class CheckerBuilder:
         self.flight_format_: str = "jsonl"
         self.memory_: bool = True
         self.pipeline_: bool = True
+        self.pipeline_depth_: Optional[int] = None  # None = auto (2)
+        self.fuse_eras_: Optional[int] = None  # None/1 = no multi-era fusion
         self.sample_: bool = True
         self.sample_k_: int = 64  # obs/sample.py DEFAULT_SAMPLE_K
 
@@ -258,19 +260,50 @@ class CheckerBuilder:
         self.stage_profile_iters_ = max(1, int(iters))
         return self
 
-    def pipeline(self, enable: bool = True) -> "CheckerBuilder":
+    def pipeline(
+        self,
+        enable: bool = True,
+        depth: Optional[int] = None,
+        fuse: Optional[int] = None,
+    ) -> "CheckerBuilder":
         """Speculative era pipelining on the device engines (default ON).
 
         While era N's packed-params readback is still in flight, the
-        driver chains era N+1 directly off the still-on-device
-        table/queue/params — the device loop's entry gate makes the
-        chained dispatch an exact no-op whenever era N actually needed
-        host intervention (spill, grow, discovery finish, probe error),
-        so results are bit-identical to the serial driver; only the
-        dispatch gap between eras disappears. Disable to force the
+        driver chains further eras directly off the still-on-device
+        table/queue/params — the device loop's entry gate makes a
+        chained dispatch an exact no-op whenever an earlier era actually
+        needed host intervention (spill, grow, discovery finish, probe
+        error), so results are bit-identical to the serial driver; only
+        the dispatch gap between eras disappears. Disable to force the
         serial dispatch -> readback -> dispatch driver (useful when
-        bisecting timing-sensitive telemetry)."""
+        bisecting timing-sensitive telemetry).
+
+        ``depth`` bounds the speculative in-flight chain: up to that many
+        era dispatches are kept queued beyond the one being consumed,
+        each with a non-blocking readback queued behind it (``None`` =
+        auto, currently 2; ``1`` reproduces the original depth-1
+        speculation). The host consumes readbacks strictly in order and
+        peeks ``P_STEPS`` to tell consumed work from wasted speculation.
+
+        ``fuse`` rolls that many eras into ONE compiled device program
+        (an inner loop around the era body that continues only on pure
+        budget exits), so one dispatch+readback can retire up to ``fuse``
+        eras. ``None``/``1`` = no fusion. The packed params grow
+        per-inner-era flight-record lanes, and the driver auto-degrades
+        a dispatch to one era whenever per-era host work is pending
+        (spill backlog, checkpoint cadence nearly due, state-count
+        targets, timeouts)."""
         self.pipeline_ = bool(enable)
+        if depth is not None:
+            depth = int(depth)
+            if depth < 1:
+                raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.pipeline_depth_ = depth
+        if fuse is not None:
+            fuse = int(fuse)
+            if fuse < 1:
+                raise ValueError(f"pipeline fuse must be >= 1, got {fuse}")
+        self.fuse_eras_ = fuse
         return self
 
     # -- static analysis (speclint; stateright_tpu.analysis) -----------------

@@ -69,3 +69,31 @@ def donate_argnums_safe(*argnums: int) -> Tuple[int, ...]:
     if jax.default_backend() == "cpu":
         return ()
     return tuple(argnums)
+
+
+def donate_argnums_pinned(
+    argnums: Tuple[int, ...], pinned: Tuple[int, ...] = ()
+) -> Tuple[int, ...]:
+    """`donate_argnums_safe` minus the argnums whose INPUT buffers the
+    host may still read after dispatch — the pinned-source analysis for
+    speculative era chaining.
+
+    Donating an input aliases its buffer to an output, so the handle the
+    caller still holds is dead the moment the dispatch is enqueued. That
+    is fine for operands the driver has already consumed (the serial
+    dispatch->readback->dispatch path reads every readback before the
+    next launch), but a CHAINED dispatch launches while the previous
+    era's packed-params readback is still in flight: its params operand
+    is exactly that not-yet-consumed output, and donating it would race
+    the async device->host copy against the aliased in-place write (JAX
+    surfaces the race as a deleted-buffer error on the readback). The
+    engines therefore build two jit variants of one era program — a
+    serial variant donating the full operand set and a chain variant
+    with the readback-pinned argnums excluded — and pick per dispatch.
+
+    ``argnums`` is the full donation set; ``pinned`` the subset whose
+    sources an in-flight readback may pin. Returns `()` on CPU exactly
+    like `donate_argnums_safe` (same miscompile hazard).
+    """
+    pin = set(pinned)
+    return tuple(a for a in donate_argnums_safe(*argnums) if a not in pin)

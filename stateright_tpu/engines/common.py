@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from ..checker import Checker, CheckerBuilder
 from ..core import Expectation
@@ -471,6 +471,7 @@ class HostEngineBase(Checker):
         spill_rows: int = 0,
         shards: Optional[Dict[str, Any]] = None,
         grow_rows: Optional[int] = None,
+        inner: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         """Append one era to the flight recording (no-op when disabled).
         Registry counters that move off the hot path (refill/grow/
@@ -478,7 +479,11 @@ class HostEngineBase(Checker):
         don't have to thread per-era volumes through their loops.
         ``grow_rows`` is what the engine's table-grow trigger compares
         (max per-shard unique on the mesh); the memory forecaster fits
-        its growth curve to it, defaulting to ``unique``."""
+        its growth curve to it, defaulting to ``unique``. ``inner`` is
+        the per-inner-era attribution of a FUSED dispatch (fields per
+        FlightRecorder.record_fused): the one readback then appends
+        len(inner) records, with the once-per-dispatch counters on the
+        last."""
         mem = None
         if self._memory is not None:
             mem = self._memory.on_era(
@@ -493,23 +498,44 @@ class HostEngineBase(Checker):
         }
         prev = self._flight_prev_counters
         self._flight_prev_counters = cur
-        rec = fr.record(
-            device_era_secs=device_era_secs,
-            steps=steps,
-            generated=generated,
-            unique=unique,
-            frontier=frontier,
-            load_factor=load_factor,
-            take_cap=take_cap,
-            spill_rows=spill_rows,
-            refill_rows=cur["refill_rows"] - prev.get("refill_rows", 0),
-            table_growths=cur["table_growths"] - prev.get("table_growths", 0),
-            checkpoint_saves=(
-                cur["checkpoint_saves"] - prev.get("checkpoint_saves", 0)
-            ),
-            shards=shards,
-            memory=mem,
-        )
+        if inner is not None and len(inner) > 1:
+            rec = fr.record_fused(
+                device_era_secs=device_era_secs,
+                inner=inner,
+                take_cap=take_cap,
+                spill_rows=spill_rows,
+                refill_rows=cur["refill_rows"] - prev.get("refill_rows", 0),
+                table_growths=(
+                    cur["table_growths"] - prev.get("table_growths", 0)
+                ),
+                checkpoint_saves=(
+                    cur["checkpoint_saves"]
+                    - prev.get("checkpoint_saves", 0)
+                ),
+                shards=shards,
+                memory=mem,
+            )
+        else:
+            rec = fr.record(
+                device_era_secs=device_era_secs,
+                steps=steps,
+                generated=generated,
+                unique=unique,
+                frontier=frontier,
+                load_factor=load_factor,
+                take_cap=take_cap,
+                spill_rows=spill_rows,
+                refill_rows=cur["refill_rows"] - prev.get("refill_rows", 0),
+                table_growths=(
+                    cur["table_growths"] - prev.get("table_growths", 0)
+                ),
+                checkpoint_saves=(
+                    cur["checkpoint_saves"]
+                    - prev.get("checkpoint_saves", 0)
+                ),
+                shards=shards,
+                memory=mem,
+            )
         # Flat twins of the latest record for Prometheus (nested dicts are
         # skipped by render_prometheus) and the SSE metrics deltas.
         m = self._metrics

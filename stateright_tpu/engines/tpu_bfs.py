@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -73,11 +73,27 @@ class _ProbeBudgetExhausted(RuntimeError):
     table, and re-run the lost era (graceful degradation)."""
 
 
-# Loop cache: (id(tm), chunk, qcap, n_props) -> (tm ref, jitted loop). Reusing
-# the same function object across checker instances is what lets JAX's trace
-# cache and the persistent compilation cache actually hit (a fresh closure per
-# checker would recompile every run).
+# Loop cache: (id(tm), chunk, qcap, n_props, ...) -> (tm ref, EraProgram).
+# Reusing the same function object across checker instances is what lets
+# JAX's trace cache and the persistent compilation cache actually hit (a
+# fresh closure per checker would recompile every run).
 _LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
+
+
+class EraProgram(NamedTuple):
+    """One era program, jitted under two donation policies.
+
+    ``serial``: full operand donation — safe only when the host has
+    already consumed every input buffer (fresh upload, or dispatch after
+    the readback landed). ``chain``: the params operand pinned
+    (compat.donate_argnums_pinned) so a speculative chained dispatch can
+    feed the previous era's output back in while its async readback is
+    still in flight. On CPU both donation sets are empty and the two
+    fields alias ONE jitted callable.
+    """
+
+    serial: Any
+    chain: Any
 
 
 # Packed scalar-parameter layout. On a remote-attached TPU every individual
@@ -158,11 +174,23 @@ def _vcap(A: int, chunk: int) -> int:
     return min(chunk * A, max(128 * A, (chunk * A) // div))
 
 
-def params_len(A: int, P: int, cov: bool, sample_k: int) -> int:
+def fuse_tail_len(fuse: int) -> int:
+    """Words of the multi-era fusion tail appended to the packed params
+    when ``fuse > 1``: ``[fuse_lim, n_inner]`` followed by the
+    per-inner-era flight-record lanes ``steps[fuse] | gen[fuse] |
+    unique[fuse] | frontier[fuse]``. ``fuse <= 1`` compiles the classic
+    single-era program with NO tail, so every existing layout consumer
+    (checkpoint codec, lint, multiplex) is untouched by default."""
+    return 2 + 4 * fuse if fuse > 1 else 0
+
+
+def params_len(A: int, P: int, cov: bool, sample_k: int,
+               fuse: int = 1) -> int:
     """Length of the packed uint32 params vector the era loop carries:
     scalars + rec_fp tail + optional coverage tail + optional sampling
-    tail. This is THE layout contract — the engine, the checkpoint codec,
-    and the STR6xx program lint all size their buffers from it."""
+    tail + optional multi-era fusion tail. This is THE layout contract —
+    the engine, the checkpoint codec, and the STR6xx program lint all
+    size their buffers from it."""
     n = P_LEN + 2 * P
     if cov:
         n += _cov_len(A, P)
@@ -170,11 +198,12 @@ def params_len(A: int, P: int, cov: bool, sample_k: int) -> int:
         from ..obs.sample import slab_entries
 
         n += 4 + 5 * slab_entries(sample_k)
-    return n
+    return n + fuse_tail_len(fuse)
 
 
 def loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
-                       tcap: int, cov: bool, sample_k: int):
+                       tcap: int, cov: bool, sample_k: int,
+                       fuse: int = 1):
     """`jax.ShapeDtypeStruct` pytree matching `_build_loop`'s signature
     `(table, queue, rec_fp1, rec_fp2, params)` — lets the STR6xx program
     lint (analysis/program.py) trace/lower the era loop WITHOUT
@@ -187,13 +216,13 @@ def loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
     sds = jax.ShapeDtypeStruct
     table = (sds((2 * tcap,), u32), sds((tcap,), u32), sds((tcap,), u32))
     queue = tuple(sds((qcap,), u32) for _ in range(S + 2))
-    plen = params_len(A, P, cov, sample_k)
+    plen = params_len(A, P, cov, sample_k, fuse)
     return (table, queue, sds((P,), u32), sds((P,), u32), sds((plen,), u32))
 
 
 def seed_loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
                             tcap: int, cov: bool, sample_k: int,
-                            n_init: int):
+                            n_init: int, fuse: int = 1):
     """Abstract args for `_build_seed_loop`'s fused
     `seed_run(qinit, h1, h2, params, rec_fp1, rec_fp2)` dispatch."""
     import jax
@@ -203,7 +232,7 @@ def seed_loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
     u32 = jnp.uint32
     sds = jax.ShapeDtypeStruct
     n_init = max(1, n_init)
-    plen = params_len(A, P, cov, sample_k)
+    plen = params_len(A, P, cov, sample_k, fuse)
     return (
         sds((S + 2, n_init), u32),
         sds((n_init,), u32),
@@ -215,7 +244,8 @@ def seed_loop_abstract_args(tm: TensorModel, props, chunk: int, qcap: int,
 
 
 def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = False,
-                cov: bool = True, raw: bool = False, sample_k: int = 0):
+                cov: bool = True, raw: bool = False, sample_k: int = 0,
+                fuse: int = 1):
     """Compile the BFS device "era" loop.
 
     Returns a jitted function
@@ -246,8 +276,31 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     `top_k` and ships the smallest ``slab_entries(k)`` rows in the params
     tail, so the drain rides the existing once-per-era readback with
     ZERO extra round-trips. The host applies the exact 64-bit tie cut.
+
+    With ``fuse > 1`` up to that many ERAS run inside one compiled
+    program: an outer `lax.while_loop` re-enters the era body while the
+    previous inner era exited on a PURE step-budget boundary (every
+    device-visible trigger — spill high-water, grow limit, finish
+    policy, probe error, empty frontier, sample-slab high-water — ends
+    the fused dispatch so the host can act). The runtime fusion limit
+    rides the params fusion tail (``fuse_lim``, pass-through), so one
+    compiled program serves every degraded value down to 1, and the
+    tail reports which inner era tripped plus per-inner-era
+    steps/generated/unique/frontier lanes for exact flight records.
+    Coverage and the sampling slab accumulate ACROSS inner eras (both
+    are additive deltas drained once per readback), so one fused
+    readback is indistinguishable from the sum of its serial eras.
+
+    Non-raw builds return an `EraProgram(serial, chain)` pair: the same
+    traced program jitted twice — ``serial`` donates the full operand
+    set (table, queue, rec_fps, params; the driver only uses it when
+    every input was already consumed host-side), ``chain`` excludes the
+    readback-pinned params operand (compat.donate_argnums_pinned) for
+    speculative chained dispatches. On CPU both donation sets resolve
+    empty and ONE jitted object serves both slots (no double compile).
     """
-    key = (id(tm), chunk, qcap, len(props), canon, cov, raw, sample_k)
+    fuse = max(1, int(fuse))
+    key = (id(tm), chunk, qcap, len(props), canon, cov, raw, sample_k, fuse)
     cached = _LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -258,7 +311,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     import jax.numpy as jnp
     from jax import lax
 
-    from ..compat import donate_argnums_safe
+    from ..compat import donate_argnums_pinned
     from ..fingerprint import hash_lanes_jnp
     from ..obs.coverage import DEPTH_CAP
     from ..ops import frontier as fr
@@ -301,17 +354,19 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
     # harmlessly retain duplicates) stay rare, and the scratch stays small
     # enough to be cache-hot.
     dedup_cap = 1 << max(1, (4 * vcap - 1).bit_length())
+    # Absolute offset of the fusion tail (== the fuse-free params length).
+    f_base = params_len(A, P, cov, sample_k)
 
     def loop(table, queue, rec_fp1, rec_fp2, params):
         u = jnp.uint32
         head0 = params[P_HEAD]
         count0 = params[P_COUNT]
         unique0 = params[P_UNIQUE]
-        rec_bits = params[P_REC]
+        rec_bits0 = params[P_REC]
         depth_limit = params[P_DEPTH_LIMIT]
         grow_limit = params[P_GROW_LIMIT]
         high_water = params[P_HIGH_WATER]
-        max_steps = params[P_MAX_STEPS]
+        max_steps0 = params[P_MAX_STEPS]
         fin_any = params[P_FIN_ANY]
         fin_all = params[P_FIN_ALL]
         fin_all_en = params[P_FIN_ALL_EN]
@@ -348,7 +403,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             (
                 _table, _queue, _head, count, unique, _gen, steps,
                 err_cnt, _take_cap, rec_acc, _hseen, _f1, _f2, _fd, _covc,
-                sampc,
+                sampc, max_steps,
             ) = carry
             fin_hit = ((rec_acc & fin_any) != u(0)) | (
                 (fin_all_en != u(0)) & ((rec_acc & fin_all) == fin_all)
@@ -387,6 +442,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 faccd,
                 covc,
                 sampc,
+                max_steps,
             ) = carry
             take = jnp.minimum(jnp.minimum(count, u(chunk)), take_cap)
             if sample_k:
@@ -642,6 +698,7 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 faccd,
                 covc,
                 sampc,
+                max_steps,
             )
 
         zero_lane = jnp.zeros(chunk, dtype=jnp.uint32) + (head0 & u(0))
@@ -669,103 +726,223 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
             if sample_k
             else ()
         )
-        init = (
-            table,
-            queue,
-            head0,
-            count0,
-            unique0,
-            u(0),  # generated delta
-            u(0),  # steps executed
-            params[P_ERR],  # unresolved-insert count (gates the era closed;
-            # nonzero input = a seeding-time error surfacing on first read)
-            jnp.minimum(jnp.maximum(params[P_TAKE_CAP], u(1)), u(chunk)),
-            rec_bits,  # scalar discovery bits accumulated for the fin gate
-            tuple(false_lane for _ in range(P)),
-            tuple(zero_lane for _ in range(P)),
-            tuple(zero_lane for _ in range(P)),
-            tuple(zero_lane for _ in range(P)),
-            covc0,
-            sampc0,
-        )
-        (
-            table,
-            queue,
-            head,
-            count,
-            unique,
-            gen,
-            steps,
-            err_cnt,
-            take_cap_out,
-            _rec_acc,
-            hseen,
-            facc1,
-            facc2,
-            faccd,
-            covc_out,
-            sampc_out,
-        ) = lax.while_loop(cond, body, init)
+        def run_era(table, queue, head0, count0, unique0, rec_bits,
+                    max_steps, err0, take_cap0, covc, sampc,
+                    rec_fp1, rec_fp2):
+            # ONE era: the data-dependent while_loop plus its once-per-era
+            # epilogue. Factored so the multi-era fusion path below can
+            # chain N of these inside an outer device loop — coverage and
+            # the sampling slab THREAD through (both are additive deltas /
+            # persistent occupancy, drained once per readback), while the
+            # fingerprint snapshot lanes reset per era (rec_bits threading
+            # keeps first-discovery-wins across eras, matching the host
+            # ordering of the serial driver).
+            init = (
+                table,
+                queue,
+                head0,
+                count0,
+                unique0,
+                u(0),  # generated delta
+                u(0),  # steps executed
+                err0,  # unresolved-insert count (gates the era closed;
+                # nonzero input = a seeding-time error surfacing on first
+                # read)
+                jnp.minimum(jnp.maximum(take_cap0, u(1)), u(chunk)),
+                rec_bits,  # scalar discovery bits accumulated for fin gate
+                tuple(false_lane for _ in range(P)),
+                tuple(zero_lane for _ in range(P)),
+                tuple(zero_lane for _ in range(P)),
+                tuple(zero_lane for _ in range(P)),
+                covc,
+                sampc,
+                max_steps,
+            )
+            (
+                table,
+                queue,
+                head,
+                count,
+                unique,
+                gen,
+                steps,
+                err_cnt,
+                take_cap_out,
+                _rec_acc,
+                hseen,
+                facc1,
+                facc2,
+                faccd,
+                covc_out,
+                sampc_out,
+                _ms,
+            ) = lax.while_loop(cond, body, init)
 
-        # Block-level epilogue (runs ONCE per block, outside the loop, where
-        # argmax / dynamic gathers are cheap): extract discovery fingerprints
-        # from the snapshots and the max depth from the ring. Depth along the
-        # ring is non-decreasing, so the deepest state visited is the last
-        # one popped, at ring slot head-1.
-        rec_bits_out = rec_bits
-        for i in range(P):
-            found = jnp.any(hseen[i])
-            # Select the SHALLOWEST snapshot hit, not an arbitrary one: BFS
-            # must report a shortest counterexample even when later, deeper
-            # iterations hit the property at other chunk positions.
-            sel = jnp.argmin(
-                jnp.where(hseen[i], faccd[i], u(0xFFFFFFFF))
+            # Era-level epilogue (runs ONCE per era, outside the step loop,
+            # where argmax / dynamic gathers are cheap): extract discovery
+            # fingerprints from the snapshots and the max depth from the
+            # ring. Depth along the ring is non-decreasing, so the deepest
+            # state visited is the last one popped, at ring slot head-1.
+            # Under fusion this executes per INNER era — still bounded by
+            # the fusion factor, not the step count, so the platform rule
+            # (reductions only at era granularity) holds.
+            rec_bits_out = rec_bits
+            for i in range(P):
+                found = jnp.any(hseen[i])
+                # Select the SHALLOWEST snapshot hit, not an arbitrary
+                # one: BFS must report a shortest counterexample even when
+                # later, deeper iterations hit the property at other chunk
+                # positions.
+                sel = jnp.argmin(
+                    jnp.where(hseen[i], faccd[i], u(0xFFFFFFFF))
+                )
+                take_new = found & (((rec_bits_out >> u(i)) & u(1)) == u(0))
+                rec_fp1 = rec_fp1.at[i].set(
+                    jnp.where(take_new, facc1[i][sel], rec_fp1[i])
+                )
+                rec_fp2 = rec_fp2.at[i].set(
+                    jnp.where(take_new, facc2[i][sel], rec_fp2[i])
+                )
+                rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
+            maxd = jnp.where(
+                steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
             )
-            take_new = found & (((rec_bits_out >> u(i)) & u(1)) == u(0))
-            rec_fp1 = rec_fp1.at[i].set(
-                jnp.where(take_new, facc1[i][sel], rec_fp1[i])
+            # Adaptive era budget (device-side emission): the NEXT era's
+            # step budget rides the P_MAX_STEPS output slot, so a chained
+            # (speculative) dispatch — or the next INNER era of a fused
+            # dispatch — follows the exact deterministic schedule the
+            # serial driver would. TCP-slow-start shape: double after an
+            # era that exhausted its budget with no other exit reason
+            # pending, halve under spill/grow pressure, floor at
+            # BUDGET_MIN, clamp at budget_cap. budget_cap == 0 turns the
+            # emission off (pure pass-through — free-running and
+            # target-bounded runs keep their fixed budgets). The host's
+            # wall-clock cap keeps checkpoint cadence and reporter updates
+            # honest (see the engine driver).
+            fin_hit_final = ((rec_bits_out & fin_any) != u(0)) | (
+                (fin_all_en != u(0)) & ((rec_bits_out & fin_all) == fin_all)
             )
-            rec_fp2 = rec_fp2.at[i].set(
-                jnp.where(take_new, facc2[i][sel], rec_fp2[i])
+            pressure = (count > high_water) | (unique > grow_limit)
+            budget_only = (
+                (steps >= max_steps)
+                & (count > u(0))
+                & ~pressure
+                & (err_cnt == u(0))
+                & ~fin_hit_final
             )
-            rec_bits_out = rec_bits_out | (found.astype(u) << u(i))
-        maxd = jnp.where(
-            steps > 0, queue[S + 1][(head - u(1)) & u(qmask)], u(0)
-        )
-        # Adaptive era budget (device-side emission): the NEXT era's step
-        # budget rides the P_MAX_STEPS output slot, so a chained
-        # (speculative) dispatch follows the exact deterministic schedule
-        # the serial driver would. TCP-slow-start shape: double after an
-        # era that exhausted its budget with no other exit reason pending,
-        # halve under spill/grow pressure, floor at BUDGET_MIN, clamp at
-        # budget_cap. budget_cap == 0 turns the emission off (pure
-        # pass-through — free-running and target-bounded runs keep their
-        # fixed budgets). The host's wall-clock cap keeps checkpoint
-        # cadence and reporter updates honest (see the engine driver).
-        fin_hit_final = ((rec_bits_out & fin_any) != u(0)) | (
-            (fin_all_en != u(0)) & ((rec_bits_out & fin_all) == fin_all)
-        )
-        pressure = (count > high_water) | (unique > grow_limit)
-        budget_only = (
-            (steps >= max_steps)
-            & (count > u(0))
-            & ~pressure
-            & (err_cnt == u(0))
-            & ~fin_hit_final
-        )
-        # In adaptive mode max_steps <= budget_cap <= 2^30 always (host
-        # clamp), so the doubling cannot overflow uint32.
-        grown = jnp.minimum(jnp.maximum(max_steps, u(1)) * u(2), budget_cap)
-        shrunk = jnp.maximum(
-            jnp.minimum(max_steps, budget_cap) >> u(1), u(BUDGET_MIN)
-        )
-        next_budget = jnp.where(
-            budget_cap == u(0),
-            max_steps,
-            jnp.where(
-                pressure, shrunk, jnp.where(budget_only, grown, max_steps)
-            ),
-        )
+            # In adaptive mode max_steps <= budget_cap <= 2^30 always
+            # (host clamp), so the doubling cannot overflow uint32.
+            grown = jnp.minimum(
+                jnp.maximum(max_steps, u(1)) * u(2), budget_cap
+            )
+            shrunk = jnp.maximum(
+                jnp.minimum(max_steps, budget_cap) >> u(1), u(BUDGET_MIN)
+            )
+            next_budget = jnp.where(
+                budget_cap == u(0),
+                max_steps,
+                jnp.where(
+                    pressure, shrunk,
+                    jnp.where(budget_only, grown, max_steps),
+                ),
+            )
+            return (table, queue, head, count, unique, rec_bits_out,
+                    err_cnt, take_cap_out, covc_out, sampc_out,
+                    rec_fp1, rec_fp2, steps, gen, maxd, next_budget,
+                    budget_only)
+
+        if fuse == 1:
+            # Classic single-era program: no outer loop, no fusion tail —
+            # bit-identical lowering to the pre-fusion build.
+            (
+                table, queue, head, count, unique, rec_bits_out, err_cnt,
+                take_cap_out, covc_out, sampc_out, rec_fp1, rec_fp2,
+                steps, gen, maxd, next_budget, _budget_only,
+            ) = run_era(
+                table, queue, head0, count0, unique0, rec_bits0,
+                max_steps0, params[P_ERR], params[P_TAKE_CAP],
+                covc0, sampc0, rec_fp1, rec_fp2,
+            )
+            ftail = []
+        else:
+            # Multi-era fusion: chain up to fuse_lim eras inside ONE
+            # compiled program. The continuation gate re-derives the
+            # serial driver's re-dispatch decision ON DEVICE: an inner
+            # era chains iff its ONLY exit reason was budget exhaustion
+            # with work remaining (budget_only — no spill/grow pressure,
+            # no error, no finish hit, frontier nonempty) and the sample
+            # slab still has a full era of headroom. Every other exit
+            # needs host work, so the loop stops and the readback reports
+            # which inner era tripped (n_inner) plus per-inner-era
+            # steps/generated/unique/frontier lanes for exact flight
+            # records. fuse_lim rides the params tail (clamped to
+            # [1, fuse]), so the host degrades fusion at dispatch time —
+            # checkpoint cadence due, spill backlog, targets — without a
+            # recompile.
+            fuse_lim = jnp.minimum(
+                jnp.maximum(params[f_base], u(1)), u(fuse)
+            )
+            fzero = jnp.zeros(fuse, dtype=jnp.uint32)
+
+            def ocond(oc):
+                k, cont = oc[0], oc[1]
+                return (k < fuse_lim) & (cont != u(0))
+
+            def obody(oc):
+                (
+                    k, _cont, steps_acc, gen_acc, maxd_acc,
+                    fsteps, fgen, funiq, fcount,
+                    table, queue, head, count, unique, rec_bits, ms,
+                    err, tc, covc, sampc, rfp1, rfp2,
+                ) = oc
+                uniq_in = unique
+                (
+                    table, queue, head, count, unique, rec_bits, err, tc,
+                    covc, sampc, rfp1, rfp2, steps, gen, maxd,
+                    next_budget, budget_only,
+                ) = run_era(
+                    table, queue, head, count, unique, rec_bits, ms,
+                    err, tc, covc, sampc, rfp1, rfp2,
+                )
+                cont = budget_only.astype(u)
+                if sample_k:
+                    # One more era adds at most an era's worth of slab
+                    # entries; stop while the host-drain high-water mark
+                    # still guarantees no overflow.
+                    cont = cont & (sampc[4] <= u(s_high)).astype(u)
+                return (
+                    k + u(1), cont, steps_acc + steps, gen_acc + gen,
+                    jnp.maximum(maxd_acc, maxd),
+                    fsteps.at[k].set(steps), fgen.at[k].set(gen),
+                    funiq.at[k].set(unique - uniq_in),
+                    fcount.at[k].set(count),
+                    table, queue, head, count, unique, rec_bits,
+                    next_budget, err, tc, covc, sampc, rfp1, rfp2,
+                )
+
+            oinit = (
+                u(0), u(1), u(0), u(0), u(0),
+                fzero, fzero, fzero, fzero,
+                table, queue, head0, count0, unique0, rec_bits0,
+                max_steps0, params[P_ERR], params[P_TAKE_CAP],
+                covc0, sampc0, rec_fp1, rec_fp2,
+            )
+            (
+                k_out, _cont, steps, gen, maxd,
+                fsteps, fgen, funiq, fcount,
+                table, queue, head, count, unique, rec_bits_out,
+                next_budget, err_cnt, take_cap_out, covc_out, sampc_out,
+                rec_fp1, rec_fp2,
+            ) = lax.while_loop(ocond, obody, oinit)
+            # Fusion tail: [fuse_lim (pass-through), n_inner] +
+            # steps[fuse] | generated[fuse] | unique[fuse] |
+            # frontier[fuse] — the host splits the one readback into
+            # n_inner exact flight records.
+            ftail = [
+                jnp.stack([fuse_lim, k_out]),
+                fsteps, fgen, funiq, fcount,
+            ]
+
         parts = [
             jnp.stack(
                 [
@@ -823,16 +1000,38 @@ def _build_loop(tm: TensorModel, props, chunk: int, qcap: int, canon: bool = Fal
                 sact[:scap][topi],
                 used[topi].astype(u),
             ]
+        parts += ftail
         params_out = jnp.concatenate(parts)
         return table, queue, rec_fp1, rec_fp2, params_out
 
-    if not raw:
-        # Table and ring donate on device backends only — donation under
-        # the CPU persistent compilation cache miscompiles (compat
-        # docstring).
-        loop = jax.jit(loop, donate_argnums=donate_argnums_safe(0, 1))
-    _LOOP_CACHE[key] = (tm, loop)
-    return loop
+    if raw:
+        _LOOP_CACHE[key] = (tm, loop)
+        return loop
+    # Two donation variants of the SAME traced program (device backends
+    # only — donation under the CPU persistent compilation cache
+    # miscompiles, compat docstring). The serial variant donates every
+    # operand including the params row and the rec_fp lanes: the driver
+    # only takes it when all five inputs were consumed host-side (fresh
+    # upload / post-readback dispatch). The chain variant pins the params
+    # operand (argnum 4): a speculative chained dispatch feeds the
+    # PREVIOUS era's params output straight back in while its async
+    # device->host readback is still in flight — donating it would alias
+    # the in-place write over the copy source. rec_fps stay donated in
+    # both: solo discovery state rides the params row, the fp handles are
+    # never read back mid-chain.
+    d_serial = donate_argnums_pinned((0, 1, 2, 3, 4))
+    d_chain = donate_argnums_pinned((0, 1, 2, 3, 4), pinned=(4,))
+    serial = jax.jit(loop, donate_argnums=d_serial)
+    # On CPU both sets resolve () — reuse ONE executable, no double
+    # compile (tier-1 runs on the CPU backend).
+    chain = (
+        serial
+        if d_chain == d_serial
+        else jax.jit(loop, donate_argnums=d_chain)
+    )
+    program = EraProgram(serial, chain)
+    _LOOP_CACHE[key] = (tm, program)
+    return program
 
 
 _SEED_CACHE: Dict[Tuple, Any] = {}
@@ -840,17 +1039,22 @@ _SEED_LOOP_CACHE: Dict[Tuple, Tuple[TensorModel, Any]] = {}
 
 
 def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
-                     canon: bool, cov: bool, sample_k: int = 0):
+                     canon: bool, cov: bool, sample_k: int = 0,
+                     fuse: int = 1):
     """Fuse run seeding and the FIRST era into one jitted dispatch.
 
     On this platform every dispatch costs a ~100ms tunnel round-trip, and
     time-to-first-counterexample is a primary metric (BASELINE.md): a bug
     a few steps deep should cost ONE round-trip, not a seed trip plus an
-    era trip. The composed program inlines the jitted seeder and era loop;
-    a run whose discovery fires in era 1 (or that completes outright)
-    never pays a second dispatch.
+    era trip. The composed program inlines the raw seeder and era loop
+    (at the engine's fusion factor — the seeding dispatch fuses its
+    trailing eras exactly like a steady-state one); a run whose discovery
+    fires in era 1 (or that completes outright) never pays a second
+    dispatch.
     """
-    key = (id(tm), chunk, qcap, tcap, len(props), canon, cov, sample_k)
+    fuse = max(1, int(fuse))
+    key = (id(tm), chunk, qcap, tcap, len(props), canon, cov, sample_k,
+           fuse)
     cached = _SEED_LOOP_CACHE.get(key)
     if cached is not None and cached[0] is tm:
         return cached[1]
@@ -859,7 +1063,8 @@ def _build_seed_loop(tm: TensorModel, props, chunk: int, qcap: int, tcap: int,
 
     import jax
 
-    loop = _build_loop(tm, props, chunk, qcap, canon, cov, sample_k=sample_k)
+    loop = _build_loop(tm, props, chunk, qcap, canon, cov, raw=True,
+                       sample_k=sample_k, fuse=fuse)
     seed = _build_seed(tm.state_width, qcap, tcap)
 
     @jax.jit
@@ -1252,9 +1457,21 @@ class TpuBfsChecker(HostEngineBase):
         # Bottom-k space sampling (obs/sample.py): the compiled loop
         # carries the capture slab only when the builder asked for it.
         self._sample_k = self._sampler.k if self._sampler is not None else 0
-        self._loop = _build_loop(
+        # Multi-era fusion factor (CheckerBuilder.pipeline(fuse=N)): the
+        # compiled program chains up to N eras on device per dispatch.
+        # The factor is part of the loop-cache / executable-cache key.
+        self._fuse = max(1, int(getattr(builder, "fuse_eras_", None) or 1))
+        program = _build_loop(
             self.tm, self._tprops, self._chunk, self._qcap, self._canon,
-            self._cov, sample_k=self._sample_k,
+            self._cov, sample_k=self._sample_k, fuse=self._fuse,
+        )
+        self._loop = program.serial
+        self._loop_chain = program.chain
+        # Absolute params offset of the fusion tail (only present when
+        # fuse > 1); cached for the driver's readback splitting.
+        self._fbase = params_len(
+            self.tm.max_actions, len(self._tprops), self._cov,
+            self._sample_k,
         )
 
         # Host-side bookkeeping.
@@ -1281,11 +1498,20 @@ class TpuBfsChecker(HostEngineBase):
         # engine.
         self._mux_lane = bool(getattr(builder, "multiplex_lane_", False))
         # Speculative era pipelining (CheckerBuilder.pipeline(), default
-        # on): chain era N+1 off the still-on-device state while era N's
-        # readback is in flight. See the _run driver for the soundness
-        # argument (chained dispatch is an identity no-op on every
-        # device-visible host-intervention exit).
+        # on): keep up to K eras chained off the still-on-device state
+        # while their readbacks are in flight. See the _run driver for
+        # the soundness argument (chained dispatch is an identity no-op
+        # on every device-visible host-intervention exit).
         self._pipeline = bool(getattr(builder, "pipeline_", True))
+        depth = getattr(builder, "pipeline_depth_", None)
+        # Auto depth 2: one extra era of overlap beyond PR 14's depth-1
+        # covers the readback+bookkeeping gap; deeper chains only pay off
+        # when host work per era exceeds a full device era (rare), while
+        # every extra in-flight era grows the wasted-work window on
+        # host-intervention exits.
+        self._chain_depth = max(1, int(depth)) if depth is not None else 2
+        # High-water mark of in-flight chained dispatches (gauge).
+        self._chain_max = 0
         # Small-workload guard: with a state-count target under the
         # crossover, the host engine will beat this one — say so up front
         # (the run-end check below catches untargeted small runs).
@@ -1375,6 +1601,35 @@ class TpuBfsChecker(HostEngineBase):
         last_max_steps = None
         last_budget_cap = budget_cap
         take_cap = self._chunk
+        # Multi-era fusion: tail sizing and the per-dispatch degrade. The
+        # device chains up to fuse_lim eras per dispatch; the host shrinks
+        # fuse_lim to 1 (one compiled program serves every value — it
+        # rides the params tail) whenever a per-era host concern is near:
+        # spill backlog to refill, a state-count target to clamp, or a
+        # wall-clock cadence (checkpoint, timeout) past half-elapsed —
+        # fused eras can't poll mid-dispatch, so fusion backs off before
+        # it could overshoot a cadence rather than after.
+        nfuse = fuse_tail_len(self._fuse)
+        fb = self._fbase
+        last_fuse_lim = None
+
+        def _fuse_lim_now() -> int:
+            if self._fuse <= 1:
+                return 1
+            if self._spill or self._target_state_count is not None:
+                return 1
+            now = time.monotonic()
+            if (
+                self._ckpt_every is not None
+                and now - self._last_ckpt >= self._ckpt_every / 2
+            ):
+                return 1
+            if (
+                self._deadline is not None
+                and now >= self._deadline - self._timeout / 2
+            ):
+                return 1
+            return self._fuse
 
         _dbg("run: encoding inits")
         if self._resume_from is not None:
@@ -1450,7 +1705,12 @@ class TpuBfsChecker(HostEngineBase):
                 max_steps0 = max(
                     1, min(max_steps0, 1 + remaining // max(1, C * A))
                 )
-            template = np.zeros(P_LEN + 2 * P + ncov + nsamp, dtype=np.uint32)
+            template = np.zeros(
+                P_LEN + 2 * P + ncov + nsamp + nfuse, dtype=np.uint32
+            )
+            if nfuse:
+                last_fuse_lim = _fuse_lim_now()
+                template[fb] = last_fuse_lim
             if self._sampler is not None:
                 t1, t2 = self._sampler.threshold_parts()
                 template[s_base] = t1
@@ -1474,13 +1734,14 @@ class TpuBfsChecker(HostEngineBase):
             _dbg("run: dispatching fused seed+first-era")
             seed_run = _build_seed_loop(
                 tm, self._tprops, C, self._qcap, self._tcap, self._canon,
-                self._cov, sample_k=self._sample_k,
+                self._cov, sample_k=self._sample_k, fuse=self._fuse,
             )
             self._era_t0 = time.monotonic()
             table, queue, rec_fp1, rec_fp2, params_dev = seed_run(
                 jnp.asarray(qinit), jnp.asarray(h1), jnp.asarray(h2),
                 jnp.asarray(template), rec_fp1, rec_fp2,
             )
+            self._metrics.inc("dispatches")
             head = 0
             count = n_init
             # Provisional (exact unless dup inits); corrected at first read.
@@ -1522,9 +1783,15 @@ class TpuBfsChecker(HostEngineBase):
                 # percentiles for /stats and the Prometheus exposition.
                 self._metrics.observe("era_secs", era_dt)
                 self._era_t0 = None
+            # Fused dispatch: the readback covers n_inner on-device eras;
+            # the fusion tail carries which inner era tripped plus the
+            # per-inner-era lanes the flight records need.
+            n_inner = 1
+            if nfuse:
+                n_inner = max(1, min(int(vals[fb + 1]), self._fuse))
             _dbg(
                 f"era result steps={vals[10]} gen={vals[8]} count={vals[1]} "
-                f"unique={vals[2]} rec={vals[3]:b}"
+                f"unique={vals[2]} rec={vals[3]:b} inner={n_inner}"
             )
             err = int(vals[11])
             if not err and self._chaos_probe_error_era is not None and (
@@ -1565,11 +1832,15 @@ class TpuBfsChecker(HostEngineBase):
                 # Wall-clock cap feedback: let the device's slow-start
                 # climb only while eras stay well inside the polling
                 # cadence; back the cap off when an era overshoots it.
-                if era_dt < poll_target / 2 and budget_cap < cap_limit:
+                # Under fusion the dispatch covers n_inner eras — the
+                # feedback steers the PER-ERA budget, so compare the
+                # per-era share of the wall time.
+                per_era_dt = era_dt / n_inner
+                if per_era_dt < poll_target / 2 and budget_cap < cap_limit:
                     budget_cap = min(budget_cap * 2, cap_limit)
-                elif era_dt > poll_target and budget_cap > BUDGET_MIN:
+                elif per_era_dt > poll_target and budget_cap > BUDGET_MIN:
                     budget_cap = max(budget_cap // 2, BUDGET_MIN)
-            self._metrics.inc("eras")
+            self._metrics.inc("eras", n_inner)
             self._metrics.inc("steps", int(vals[10]))
             self._metrics.inc("states_generated", int(vals[8]))
             self._metrics.set_gauge("take_cap", take_cap)
@@ -1689,7 +1960,37 @@ class TpuBfsChecker(HostEngineBase):
 
             # Flight record after spill/checkpoint so this era's host work
             # lands in its own host_gap (zero extra device reads: every
-            # field is from `vals` or host clocks).
+            # field is from `vals` or host clocks). A fused dispatch
+            # splits into one record per inner era from the tail lanes
+            # (steps/generated/unique-delta/frontier), keeping the
+            # recording exact: the wall/device identity holds across the
+            # group, and the per-era counters sum to the dispatch totals.
+            inner = None
+            if nfuse and n_inner > 1:
+                fsteps = vals[fb + 2 : fb + 2 + self._fuse]
+                fgen = vals[
+                    fb + 2 + self._fuse : fb + 2 + 2 * self._fuse
+                ]
+                funiq = vals[
+                    fb + 2 + 2 * self._fuse : fb + 2 + 3 * self._fuse
+                ]
+                fcount = vals[
+                    fb + 2 + 3 * self._fuse : fb + 2 + 4 * self._fuse
+                ]
+                u_before = self._unique - int(funiq[:n_inner].sum())
+                inner = []
+                uacc = u_before
+                for j in range(n_inner):
+                    uacc += int(funiq[j])
+                    inner.append(
+                        {
+                            "steps": int(fsteps[j]),
+                            "generated": int(fgen[j]),
+                            "unique": uacc,
+                            "frontier": int(fcount[j]),
+                            "load_factor": round(uacc / self._tcap, 4),
+                        }
+                    )
             self._flight_record(
                 device_era_secs=era_dt,
                 steps=int(vals[10]),
@@ -1699,6 +2000,7 @@ class TpuBfsChecker(HostEngineBase):
                 load_factor=round(self._unique / self._tcap, 4),
                 take_cap=take_cap,
                 spill_rows=spilled,
+                inner=inner,
             )
 
             if self._finish_matched(self._discovery_fps):
@@ -1808,9 +2110,19 @@ class TpuBfsChecker(HostEngineBase):
                 max_steps = max(1, min(max_steps, 1 + remaining // max(1, C * A)))
             if max_steps != budget or budget_cap != last_budget_cap:
                 host_dirty = True
+            # Fusion degrade: a changed fuse_lim can only reach the device
+            # through an upload (the tail passes through otherwise).
+            fuse_lim = _fuse_lim_now()
+            if nfuse and fuse_lim != last_fuse_lim:
+                host_dirty = True
 
             if host_dirty:
-                arr = np.zeros(P_LEN + 2 * P + ncov + nsamp, dtype=np.uint32)
+                arr = np.zeros(
+                    P_LEN + 2 * P + ncov + nsamp + nfuse, dtype=np.uint32
+                )
+                if nfuse:
+                    arr[fb] = fuse_lim
+                    last_fuse_lim = fuse_lim
                 if self._sampler is not None:
                     t1, t2 = self._sampler.threshold_parts()
                     arr[s_base] = t1
@@ -1846,15 +2158,26 @@ class TpuBfsChecker(HostEngineBase):
             table, queue, rec_fp1, rec_fp2, params_dev = self._loop(
                 table, queue, rec_fp1, rec_fp2, params_in
             )
+            self._metrics.inc("dispatches")
             _dbg(
                 f"block dirty={host_dirty} max_steps={max_steps} "
                 f"dispatch={time.monotonic() - _t0:.3f}s"
             )
-            spec_params = None
+            # K-deep speculative chain (oldest first): chain[i] is the
+            # params output of the i-th era chained past the one whose
+            # readback (params_dev) the host is about to consume;
+            # chain_t0[i] its dispatch timestamp.
+            chain: List[Any] = []
+            chain_t0: List[float] = []
             try:
                 while True:
-                    if not (
+                    # Top up the chain while every host-only concern is
+                    # quiet: each chained era launches off the newest
+                    # on-device params with its readback queued behind the
+                    # ones already in flight.
+                    while (
                         pipeline
+                        and len(chain) < self._chain_depth
                         and not self._spill
                         and not self._ckpt_stop.is_set()
                         and not self._timed_out()
@@ -1864,22 +2187,35 @@ class TpuBfsChecker(HostEngineBase):
                             < self._ckpt_every
                         )
                     ):
+                        # Kick the oldest pending readback without
+                        # blocking, then chain off the on-device state
+                        # (the chain variant pins the params operand, so
+                        # every readback source stays live).
+                        src = chain[-1] if chain else params_dev
+                        try:
+                            src.copy_to_host_async()
+                        except AttributeError:
+                            pass  # CPU backend: the copy is free anyway
+                        t0 = time.monotonic()
+                        (
+                            table, queue, rec_fp1, rec_fp2, nxt,
+                        ) = self._loop_chain(
+                            table, queue, rec_fp1, rec_fp2, src
+                        )
+                        self._metrics.inc("dispatches")
+                        self._metrics.inc("spec_dispatch")
+                        chain.append(nxt)
+                        chain_t0.append(t0)
+                        if len(chain) > self._chain_max:
+                            self._chain_max = len(chain)
+                            self._metrics.set_gauge(
+                                "spec_chain_depth", self._chain_max
+                            )
+                    if not chain:
                         # Serial boundary: consume the in-flight era with
                         # full host services (spill, checkpoint, stop).
                         process_result()
                         break
-                    # Kick the era-N readback without blocking, then chain
-                    # era N+1 off the on-device state (params and rec_fp
-                    # are NOT donated, so the readback source stays live).
-                    try:
-                        params_dev.copy_to_host_async()
-                    except AttributeError:
-                        pass  # CPU backend: the copy below is free anyway
-                    spec_t0 = time.monotonic()
-                    table, queue, rec_fp1, rec_fp2, spec_params = self._loop(
-                        table, queue, rec_fp1, rec_fp2, params_dev
-                    )
-                    self._metrics.inc("spec_dispatch")
                     process_result(spec_in_flight=True)
                     if (
                         not stop
@@ -1888,35 +2224,40 @@ class TpuBfsChecker(HostEngineBase):
                         and params_dev is not None
                         and self._unique + vcap <= vs.MAX_LOAD * self._tcap
                     ):
-                        # Era N ended inside every gate: the speculative
-                        # era IS era N+1 and has been executing since era
-                        # N's readback completed. Marginal timing anchor:
-                        # readback-to-readback, so the overlapped dispatch
-                        # books as device time, not host gap.
-                        params_dev = spec_params
-                        spec_params = None
+                        # The era ended inside every gate: the oldest
+                        # chained era IS the next era and has been
+                        # executing since this readback completed.
+                        # Marginal timing anchor: readback-to-readback, so
+                        # the overlapped dispatch books as device time,
+                        # not host gap.
+                        params_dev = chain.pop(0)
+                        chain_t0.pop(0)
                         last_max_steps = budget
                         self._era_t0 = time.monotonic()
                         continue
-                    # Host action at this boundary. A device-visible exit
-                    # (spill, grow, fin, empty frontier) made the chained
-                    # era an identity no-op — account it as wasted
-                    # speculation, keep its (value-identical) outputs, and
-                    # fall back to the serial path. A host-ONLY stop
-                    # (timeout, SIGTERM) can land mid-chain instead; the
-                    # speculative era then ran real, sound work — consume
-                    # it normally before stopping.
-                    spec, spec_params = spec_params, None
-                    self._era_t0 = spec_t0  # overlap-aware if it ran
-                    if int(np.asarray(spec)[P_STEPS]) == 0:
-                        self._metrics.inc("spec_wasted")
-                        self._era_t0 = None
-                        if params_dev is not None:
-                            params_dev = spec  # chain tail (value-equal)
-                        break
-                    params_dev = spec
-                    last_max_steps = budget
-                    process_result()
+                    # Host action at this boundary: drain the chain in
+                    # order. A device-visible exit (spill, grow, fin,
+                    # empty frontier) made every later chained era an
+                    # identity no-op — account those as wasted
+                    # speculation, keep their (value-identical) outputs.
+                    # A host-ONLY stop (timeout, SIGTERM) can land
+                    # mid-chain instead; the chained eras then ran real,
+                    # sound work — consume each normally before stopping.
+                    while chain:
+                        spec = chain.pop(0)
+                        spec_t0 = chain_t0.pop(0)
+                        if int(np.asarray(spec)[P_STEPS]) == 0:
+                            self._metrics.inc("spec_wasted")
+                            self._era_t0 = None
+                            if params_dev is not None:
+                                # Chain tail (value-equal): later
+                                # dispatches feed off this one.
+                                params_dev = spec
+                            continue
+                        params_dev = spec
+                        self._era_t0 = spec_t0  # overlap-aware
+                        last_max_steps = budget
+                        process_result(spec_in_flight=bool(chain))
                     break
             except _ProbeBudgetExhausted:
                 # Graceful degradation (degraded_regrow): discard the failed
@@ -1924,17 +2265,19 @@ class TpuBfsChecker(HostEngineBase):
                 # state), double the table, and continue — instead of
                 # aborting the whole run. Only possible with a checkpoint:
                 # the consumed frontier rows are otherwise gone.
-                if spec_params is not None:
-                    # A chained era was in flight. A REAL probe error is
-                    # device-visible (err_cnt seeds from P_ERR), so the
+                if chain:
+                    # Chained eras were in flight. A REAL probe error is
+                    # device-visible (err_cnt seeds from P_ERR), so every
                     # chained era was an identity no-op; a chaos-injected
-                    # fake may have let it run real work. Either way the
-                    # checkpoint reload below discards its buffers
-                    # wholesale — just quiesce the dispatch and count the
-                    # speculation as wasted.
-                    np.asarray(spec_params)
-                    spec_params = None
-                    self._metrics.inc("spec_wasted")
+                    # fake may have let them run real work. Either way the
+                    # checkpoint reload below discards their buffers
+                    # wholesale — just quiesce the dispatches and count
+                    # the speculation as wasted.
+                    for spec in chain:
+                        np.asarray(spec)
+                        self._metrics.inc("spec_wasted")
+                    chain = []
+                    chain_t0 = []
                 from .common import checkpoint_generations
 
                 if (
@@ -1973,6 +2316,17 @@ class TpuBfsChecker(HostEngineBase):
         if self._unique < SMALL_WORKLOAD_STATES:
             self._small_workload_hint(self._unique, "explored")
 
+        # Mega-dispatch gauges: the deepest speculative chain reached and
+        # the realized fusion ratio (device eras per host dispatch — the
+        # dispatch-amortization headline, 1.0 when neither chaining nor
+        # fusion engaged).
+        self._metrics.set_gauge("spec_chain_depth", self._chain_max)
+        n_disp = max(1, self._metrics.get("dispatches"))
+        self._metrics.set_gauge(
+            "fused_eras_per_dispatch",
+            round(self._metrics.get("eras") / n_disp, 3),
+        )
+
         self._profile_stages(table, queue)
 
         # Retained (on device) for path reconstruction; downloaded lazily.
@@ -1989,6 +2343,8 @@ class TpuBfsChecker(HostEngineBase):
                 led.attach("packed_params", params_dev)
                 led.attach("coverage_slab", params_dev)
                 led.attach("sample_slab", params_dev)
+                if self._fuse > 1:
+                    led.attach("fusion_tail", params_dev)
         return
 
     def _mem_register(self, table, queue, rec_fps, params_dev) -> None:
@@ -2014,18 +2370,19 @@ class TpuBfsChecker(HostEngineBase):
             table_capacity=self._tcap,
             coverage=self._cov,
             sample_k=self._sample_k,
+            fuse=self._fuse,
         )
-        rec.register_components(
-            sizes,
-            arrays={
-                "visited_table": table,
-                "frontier_queue": queue,
-                "record_fps": rec_fps,
-                "packed_params": params_dev,
-                "coverage_slab": params_dev,
-                "sample_slab": params_dev,
-            },
-        )
+        arrays = {
+            "visited_table": table,
+            "frontier_queue": queue,
+            "record_fps": rec_fps,
+            "packed_params": params_dev,
+            "coverage_slab": params_dev,
+            "sample_slab": params_dev,
+        }
+        if self._fuse > 1:
+            arrays["fusion_tail"] = params_dev
+        rec.register_components(sizes, arrays=arrays)
         rec.set_geometry(
             rows=self._tcap,
             max_load=vs.MAX_LOAD,

@@ -42,7 +42,7 @@ def _paxos_opts():
 def _fingerprint(c):
     """Everything the golden contract covers, in one comparable dict."""
     cov = c.coverage()
-    return dict(
+    fp = dict(
         unique=c.unique_state_count(),
         states=c.state_count(),
         max_depth=c.max_depth(),
@@ -50,6 +50,12 @@ def _fingerprint(c):
         coverage_actions=cov["actions"],
         coverage_depths=cov["depths"],
     )
+    sampler = getattr(c, "_sampler", None)
+    if sampler is not None and sampler.size():
+        # The deterministic bottom-k sample is part of the golden
+        # contract too: fusion reorders nothing the sampler can see.
+        fp["sample"] = tuple(sampler.fingerprints())
+    return fp
 
 
 @pytest.fixture(scope="module")
@@ -132,6 +138,168 @@ def test_mesh_parity_2pc5(devices):
 
 
 # ---------------------------------------------------------------------------
+# Mega-dispatch sweep (ISSUE 19 tentpole): K-deep chains x on-device
+# multi-era fusion must stay golden-identical to the serial driver.
+# ---------------------------------------------------------------------------
+#
+# depth only changes host scheduling (no new compiled shape); fuse > 1
+# compiles the inner-loop program. The sweep covers K in {1, 2, 4} and
+# fused N in {1, 4} ((4, 4) exercises fusion under a deep chain, which
+# subsumes the shallow-chain fused case): every config must reproduce
+# the serial unique count, max depth, discovery fingerprints, coverage
+# histograms, AND the deterministic bottom-k sample — and a fused run
+# must retire its eras in strictly fewer dispatches.
+
+MEGA_SWEEP = [(1, 1), (2, 1), (4, 1), (4, 4)]
+
+
+@pytest.fixture(scope="module")
+def serial_2pc5_solo():
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .coverage()
+        .pipeline(False)
+        .spawn_tpu_bfs(**OPTS)
+        .join()
+    )
+    return _fingerprint(c)
+
+
+@pytest.mark.parametrize("depth,fuse", MEGA_SWEEP)
+def test_tpu_bfs_mega_sweep_2pc5(depth, fuse, serial_2pc5_solo):
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .coverage()
+        .pipeline(depth=depth, fuse=fuse)
+        .spawn_tpu_bfs(**OPTS)
+        .join()
+    )
+    fp = _fingerprint(c)
+    assert fp == serial_2pc5_solo
+    assert fp["unique"] == 8832
+    tel = c.telemetry()
+    assert tel["spec_chain_depth"] <= depth
+    if fuse > 1:
+        # The amortization headline: strictly fewer host dispatches
+        # than device eras, and the gauge reports the realized ratio.
+        assert tel["dispatches"] < tel["eras"]
+        assert tel["fused_eras_per_dispatch"] > 1.0
+    else:
+        assert tel["fused_eras_per_dispatch"] <= 1.0
+
+
+@pytest.fixture(scope="module")
+def serial_2pc5_mesh(devices):
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .coverage()
+        .pipeline(False)
+        .spawn_sharded_bfs(
+            devices=devices,
+            chunk_size=64,
+            queue_capacity_per_shard=1 << 11,
+            table_capacity_per_shard=1 << 10,
+            sync_steps=4,
+        )
+        .join()
+    )
+    return _fingerprint(c)
+
+
+@pytest.mark.parametrize("depth,fuse", MEGA_SWEEP)
+def test_mesh_mega_sweep_2pc5(depth, fuse, devices, serial_2pc5_mesh):
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .coverage()
+        .pipeline(depth=depth, fuse=fuse)
+        .spawn_sharded_bfs(
+            devices=devices,
+            chunk_size=64,
+            queue_capacity_per_shard=1 << 11,
+            table_capacity_per_shard=1 << 10,
+            sync_steps=4,
+        )
+        .join()
+    )
+    fp = _fingerprint(c)
+    assert fp == serial_2pc5_mesh
+    assert fp["unique"] == 8832
+    tel = c.telemetry()
+    assert tel["spec_chain_depth"] <= depth
+    if fuse > 1:
+        assert tel["dispatches"] < tel["eras"]
+        assert tel["fused_eras_per_dispatch"] > 1.0
+
+
+def test_tpu_bfs_mega_parity_paxos2():
+    """The deepest config against serial on the bigger model."""
+    from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+    fps = {}
+    for cfg in (None, (4, 4)):
+        b = TensorModelAdapter(PaxosTensorExhaustive(2)).checker().coverage()
+        if cfg is None:
+            b.pipeline(False)
+        else:
+            b.pipeline(depth=cfg[0], fuse=cfg[1])
+        fps[cfg] = _fingerprint(b.spawn_tpu_bfs(**_paxos_opts()).join())
+    assert fps[(4, 4)] == fps[None]
+    assert fps[None]["unique"] == 16_668
+
+
+def test_mesh_mega_parity_paxos2(devices):
+    from stateright_tpu.models.paxos import PaxosTensorExhaustive
+
+    opts = dict(
+        devices=devices,
+        chunk_size=256,
+        queue_capacity_per_shard=1 << 14,
+        table_capacity_per_shard=1 << 13,
+        sync_steps=64,
+    )
+    fps = {}
+    for cfg in (None, (4, 4)):
+        b = TensorModelAdapter(PaxosTensorExhaustive(2)).checker().coverage()
+        if cfg is None:
+            b.pipeline(False)
+        else:
+            b.pipeline(depth=cfg[0], fuse=cfg[1])
+        fps[cfg] = _fingerprint(b.spawn_sharded_bfs(**opts).join())
+    assert fps[(4, 4)] == fps[None]
+    assert fps[None]["unique"] == 16_668
+
+
+def test_tpu_bfs_kill_resume_under_deep_chain(tmp_path):
+    """A checkpointed run killed at a boundary and resumed with a deep
+    fused chain must land on the serial golden (the final checkpoint of
+    a partial run is the exact stopping point, and the resumed mega-
+    dispatch driver replays nothing and skips nothing)."""
+    ckpt = str(tmp_path / "deep.ckpt.npz")
+    part = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .target_state_count(2_000)
+        .spawn_tpu_bfs(checkpoint_path=ckpt, **OPTS)
+        .join()
+    )
+    assert 0 < part.unique_state_count() < 8832
+    c = (
+        TensorModelAdapter(TwoPhaseTensor(5))
+        .checker()
+        .coverage()
+        .pipeline(depth=4, fuse=4)
+        .spawn_tpu_bfs(resume_from=ckpt, **OPTS)
+        .join()
+    )
+    assert c.unique_state_count() == 8832
+    c.assert_properties()
+
+
+# ---------------------------------------------------------------------------
 # Chaos: a probe-error era with a speculative era in flight
 # ---------------------------------------------------------------------------
 #
@@ -160,6 +328,7 @@ def test_tpu_bfs_chaos_spec_discard_recovers(tmp_path):
     checker = (
         TensorModelAdapter(TwoPhaseTensor(5))
         .checker()
+        .pipeline(depth=4, fuse=4)
         .spawn_tpu_bfs(
             resume_from=ckpt,
             checkpoint_path=ckpt,
@@ -197,6 +366,7 @@ def test_mesh_chaos_spec_discard_recovers(tmp_path, devices):
     checker = (
         TensorModelAdapter(TwoPhaseTensor(5))
         .checker()
+        .pipeline(depth=4, fuse=4)
         .spawn_sharded_bfs(
             resume_from=ckpt,
             checkpoint_path=ckpt,
