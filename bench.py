@@ -423,10 +423,17 @@ GATE_BASELINE_WINDOW = 5
 _GATE_HIGHER = (
     "states_per_sec", "checks_per_sec", "per_sec", "speedup",
     "spec_chain_depth", "fused_eras_per_dispatch",
+    # Out-of-core: capped-run throughput as a % of the unconstrained run
+    # on the same workload, and the auto-picked fusion factor (shallower
+    # auto-fusion = the gap heuristic regressed).
+    "retention_pct", "fuse_auto_n",
 )
 _GATE_LOWER = (
     "p50", "p95", "p99", "secs", "ms", "overhead_pct",
     "host_gap_pct", "eras", "dispatches", "bytes_per_state",
+    # Out-of-core: mean npz bytes per checkpoint save — the delta
+    # protocol's whole point is keeping this far below a full save.
+    "bytes_per_save",
 )
 
 # Sections whose numeric leaves are environment/diagnostic detail, not
@@ -1389,6 +1396,79 @@ def main() -> int:
             "telemetry": d10.telemetry(),
         }
 
+    def _sec_tpc7_outofcore():
+        # --- 2pc-7 out-of-core: capped-run retention + delta bytes ------------
+        # The SAME pipelined workload twice — unconstrained, then under a
+        # device byte cap + spill host-RAM budget + tight-cadence delta
+        # checkpoints (ISSUE 20). The gate tracks how much throughput the
+        # out-of-core tier costs (retention_pct, higher is better), how
+        # small a delta save stays vs a full save (bytes_per_save, lower
+        # is better), and the auto-picked fusion factor. The capped run
+        # must stay bit-identical to the unconstrained one.
+        import shutil
+        import tempfile
+
+        oc_opts = dict(
+            chunk_size=6144,
+            queue_capacity=1 << 16,
+            table_capacity=1 << 16,
+            sync_steps=16,
+        )
+
+        def run(ckpt=None):
+            kw = dict(oc_opts)
+            if ckpt is not None:
+                kw.update(checkpoint_path=ckpt, checkpoint_every=0.5)
+            t0 = time.perf_counter()
+            c = (
+                TensorModelAdapter(TwoPhaseTensor(7))
+                .checker()
+                .pipeline(depth=4, fuse=4)
+                .spawn_tpu_bfs(**kw)
+                .join()
+            )
+            return c, time.perf_counter() - t0
+
+        free, free_secs = run()
+        assert free.unique_state_count() == TPC7_GOLDEN, (
+            free.unique_state_count()
+        )
+        tmp = tempfile.mkdtemp(prefix="stpu-bench-oc-")
+        os.environ["STPU_DEVICE_MEMORY_BYTES"] = "16000000"
+        # 64 KiB host budget: small enough that the 2pc-7 spill wave
+        # actually reaches the npz disk tier (1 MiB never filled).
+        os.environ["STPU_SPILL_HOST_BUDGET_BYTES"] = str(1 << 16)
+        try:
+            capped, capped_secs = run(os.path.join(tmp, "oc.ckpt.npz"))
+        finally:
+            os.environ.pop("STPU_DEVICE_MEMORY_BYTES", None)
+            os.environ.pop("STPU_SPILL_HOST_BUDGET_BYTES", None)
+            shutil.rmtree(tmp, ignore_errors=True)
+        assert capped.unique_state_count() == free.unique_state_count()
+        assert capped.state_count() == free.state_count()
+        assert dict(capped._discovery_fps) == dict(free._discovery_fps)
+        tel = capped.telemetry()
+        d_saves = tel.get("checkpoint_delta_saves", 0)
+        f_saves = tel.get("checkpoint_saves", 0)
+        detail["tpc7_outofcore"] = {
+            "retention_pct": round(100.0 * free_secs / capped_secs, 1),
+            "capped_states_per_sec": round(
+                capped.state_count() / capped_secs, 1
+            ),
+            "fuse_auto_n": tel.get("fuse_auto_n"),
+            "reshard_proactive": tel.get("reshard_proactive", 0),
+            "spill_tier_rows": tel.get("spill_tier_rows", 0),
+            "delta_saves": d_saves,
+            "delta_bytes_per_save": round(
+                tel.get("checkpoint_delta_bytes", 0) / max(1, d_saves), 1
+            ),
+            "full_bytes_per_save": round(
+                tel.get("checkpoint_bytes", 0) / max(1, f_saves), 1
+            ),
+            "golden_match": True,
+            "telemetry": tel,
+        }
+
     def _sec_single_copy4():
         # --- single-copy-register check 4: bench.sh:30 parity -----------------
         # EXHAUSTIVE this round (previously only the 3x2 TTFC line): the
@@ -1688,6 +1768,7 @@ def main() -> int:
     section("paxos3", _sec_paxos3)
     section("paxos6", _sec_paxos6)
     section("tpc10_device", _sec_tpc10_device)
+    section("tpc7_outofcore", _sec_tpc7_outofcore)
 
     # partial stays True if any section recorded a (platform) error: the
     # final line only claims completeness when every golden actually ran.

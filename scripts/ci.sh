@@ -3,7 +3,7 @@
 #
 #   scripts/ci.sh
 #
-# Fifteen stages, fail-fast:
+# Sixteen stages, fail-fast:
 #   1. ruff over the repo (mechanical lint scope; see ruff.toml) — a hard
 #      failure when $CI is set, a loud skip on dev machines without it,
 #   2. the speclint dogfood — every bundled model must analyze with zero
@@ -60,7 +60,14 @@
 #      pipelined device run must equal the host oracle's sample
 #      EXACTLY, the profile must carry field sketches, and the
 #      `space_*` gauges must render in the Prometheus exposition,
-#  15. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
+#  15. an out-of-core smoke: 2pc-5 under a device byte cap AND a spill
+#      host-RAM budget small enough to force the frontier onto the disk
+#      tier, with delta checkpoints at a tight cadence — must match the
+#      8,832 golden bit-for-bit while having tiered spill to disk (and
+#      refilled every row back), fired >= 1 forecast-triggered proactive
+#      reshard, written >= 2 delta checkpoint generations, and kept the
+#      mean delta save strictly smaller than the mean full save,
+#  16. the tier-1 pytest line from ROADMAP.md (host/CPU; the device
 #      goldens run under JAX_PLATFORMS=cpu like the test suite does).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -628,6 +635,59 @@ print(
     f"{len(profile['fields'])} field sketches"
 )
 PY
+
+echo "== out-of-core smoke =="
+_OC_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu STPU_OC_TMP="$_OC_TMP" python - <<'PY'
+import os
+
+# Uncapped oracle FIRST — the caps are read from the environment at
+# engine construction, so the reference spawns before they exist.
+from stateright_tpu import TensorModelAdapter
+from stateright_tpu.models import TwoPhaseTensor
+
+
+def fingerprint(c):
+    return (c.unique_state_count(), c.state_count(), c.max_depth(),
+            dict(c._discovery_fps))
+
+
+# chunk 32 / queue 1<<10: small enough that the 2pc-5 frontier overflows
+# the device queue AND the 8 KiB host budget, pushing spill blocks onto
+# the npz disk tier; sync_steps 4 gives the forecaster many short eras.
+opts = dict(chunk_size=32, queue_capacity=1 << 10, table_capacity=1 << 8,
+            sync_steps=4)
+ref = TensorModelAdapter(TwoPhaseTensor(5)).checker().spawn_tpu_bfs(**opts).join()
+assert ref.unique_state_count() == 8832, ref.unique_state_count()
+
+os.environ["STPU_DEVICE_MEMORY_BYTES"] = "300000"   # forces exhaustion forecast
+os.environ["STPU_SPILL_HOST_BUDGET_BYTES"] = "8192"  # forces the disk tier
+ckpt = os.path.join(os.environ["STPU_OC_TMP"], "oc.ckpt.npz")
+capped = (
+    TensorModelAdapter(TwoPhaseTensor(5)).checker()
+    .spawn_tpu_bfs(checkpoint_path=ckpt, checkpoint_every=1e-4, **opts)
+    .join()
+)
+tel = capped.telemetry()
+assert fingerprint(capped) == fingerprint(ref), "capped run diverged"
+assert tel.get("spill_tier_rows", 0) > 0, "no frontier rows hit the disk tier"
+assert tel.get("spill_tier_refill_rows") == tel.get("spill_tier_rows"), (
+    "disk tier not fully refilled", tel.get("spill_tier_rows"),
+    tel.get("spill_tier_refill_rows"))
+assert tel.get("reshard_proactive", 0) >= 1, "no proactive reshard fired"
+assert tel.get("checkpoint_delta_saves", 0) >= 2, tel.get("checkpoint_delta_saves")
+delta_per = tel["checkpoint_delta_bytes"] / tel["checkpoint_delta_saves"]
+full_per = tel["checkpoint_bytes"] / tel["checkpoint_saves"]
+assert delta_per < full_per, (delta_per, full_per)
+print(
+    f"out-of-core smoke OK: 8832 golden under 300 KB cap, "
+    f"{tel['spill_tier_rows']} rows tiered to disk and refilled, "
+    f"{tel['reshard_proactive']} proactive reshards, "
+    f"{tel['checkpoint_delta_saves']} delta saves "
+    f"({delta_per / 1024:.1f} KiB/delta vs {full_per / 1024:.1f} KiB/full)"
+)
+PY
+rm -rf "$_OC_TMP"
 
 echo "== tier-1 tests =="
 set -o pipefail

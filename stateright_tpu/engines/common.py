@@ -25,6 +25,14 @@ from ..obs.trace import make_trace_writer, start_profile, stop_profile
 
 BLOCK_SIZE = 1500  # states per finish_when re-check; reference bfs.rs:130
 
+# Auto-N fusion (ISSUE 20): `_fuse_auto_n` needs this much flight history
+# before trusting host_gap_pct, re-evaluates at this era cadence, and
+# halves the factor only when the gap is already below this share of the
+# wall clock (fusion has nothing left to amortize there).
+FUSE_AUTO_MIN_ERAS = 8
+FUSE_AUTO_RECHECK_ERAS = 8
+FUSE_AUTO_LOW_GAP_PCT = 2.0
+
 _log = get_logger("engines.common")
 
 
@@ -543,6 +551,33 @@ class HostEngineBase(Checker):
         m.set_gauge("flight_device_era_secs", rec["device_era_secs"])
         m.set_gauge("flight_host_gap_secs", rec["host_gap_secs"])
 
+    def _fuse_auto_n(self, fuse: int) -> int:
+        """Auto-N fusion pick (ISSUE 20 satellite of ROADMAP item 1a):
+        instead of pinning the compiled maximum, choose the inner-era cap
+        from recent flight history. A high host gap means the dispatch
+        gap dominates — run the full factor; a near-zero gap means fusion
+        has little left to amortize, so halve the exposure to mid-dispatch
+        overshoot (never below 2: one compiled program serves every N and
+        a degrade-to-1 already has its own triggers in `_fuse_lim_now`).
+        Recomputed every FUSE_AUTO_RECHECK_ERAS eras — `summary()` walks
+        the whole recording. The chosen N lands on the `fuse_auto_n`
+        gauge (gate-tracked in bench history)."""
+        eras = self._metrics.get("eras")
+        cached = getattr(self, "_fuse_auto_cache", None)
+        if cached is not None and eras - cached[0] < FUSE_AUTO_RECHECK_ERAS:
+            return cached[1]
+        n = fuse
+        fr = self._flight
+        if fr is not None:
+            s = fr.summary()
+            if s.get("eras", 0) >= FUSE_AUTO_MIN_ERAS:
+                gap = float(s.get("host_gap_pct") or 0.0)
+                if gap < FUSE_AUTO_LOW_GAP_PCT:
+                    n = max(2, fuse // 2)
+        self._fuse_auto_cache = (eras, n)
+        self._metrics.set_gauge("fuse_auto_n", n)
+        return n
+
     def _action_label(self, action: Any) -> str:
         """Memoized model.format_action — hot-loop action attribution must
         not re-format per generated successor. Unhashable actions fall
@@ -800,16 +835,15 @@ def checkpoint_generations(path: str) -> list:
     return out
 
 
-def save_checkpoint_atomic(path: str, meta: dict, arrays: dict, *,
-                           keep: int = 1, metrics=None) -> None:
-    """Write one checkpoint crash-safely: tmp + fsync + generation rotation
-    + rename + directory fsync, with the content digest in the manifest."""
+def _write_npz_atomic(path: str, meta: dict, arrays: dict) -> dict:
+    """Digest + serialize one npz to ``path + ".tmp.npz"``, fsynced.
+    Returns the final meta (with the digest); the caller finishes the
+    rename so it can interleave generation rotation."""
     import json
     import os
 
     import numpy as np
 
-    t0 = time.monotonic()
     meta = dict(meta)
     meta["digest"] = _checkpoint_digest(arrays)
     payload = dict(arrays)
@@ -821,6 +855,33 @@ def save_checkpoint_atomic(path: str, meta: dict, arrays: dict, *,
         np.savez_compressed(f, **payload)
         f.flush()
         os.fsync(f.fileno())
+    return meta
+
+
+def _fsync_dir(path: str) -> None:
+    import os
+
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platforms without directory fsync still get the file fsync
+
+
+def save_checkpoint_atomic(path: str, meta: dict, arrays: dict, *,
+                           keep: int = 1, metrics=None) -> dict:
+    """Write one checkpoint crash-safely: tmp + fsync + generation rotation
+    + rename + directory fsync, with the content digest in the manifest.
+    Returns the final meta — the delta layer pins its chain to the
+    returned ``digest``."""
+    import os
+
+    t0 = time.monotonic()
+    meta = _write_npz_atomic(path, meta, arrays)
+    tmp = path + ".tmp.npz"
     # Rotate the survivors BEFORE the rename lands: the previous good
     # checkpoint must exist (as `.1`) at every instant a crash could hit.
     if keep > 1 and os.path.exists(path):
@@ -830,18 +891,12 @@ def save_checkpoint_atomic(path: str, meta: dict, arrays: dict, *,
                 os.replace(older, f"{path}.{g}")
         os.replace(path, f"{path}.1")
     os.replace(tmp, path)
-    try:
-        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:
-        pass  # platforms without directory fsync still get the file fsync
+    _fsync_dir(path)
     if metrics is not None:
         metrics.inc("checkpoint_saves")
         metrics.inc("checkpoint_bytes", os.path.getsize(path))
         metrics.add_phase("checkpoint_save", time.monotonic() - t0)
+    return meta
 
 
 def load_checkpoint_verified(path: str):
@@ -906,6 +961,210 @@ def load_checkpoint_with_fallback(path: str, metrics=None):
     raise CheckpointCorruptError(
         "no loadable checkpoint generation:\n  " + "\n  ".join(failures)
     )
+
+
+# -- incremental delta checkpoints (ISSUE 20, on top of the generational
+# protocol above) -------------------------------------------------------------
+#
+# A large visited table rewrites gigabytes every cadence tick under the
+# full-save protocol, yet between ticks only newly claimed slots change
+# (slots never move absent a rehash, and a rehash doubles tcap — which
+# forces a fresh base). A delta checkpoint therefore carries: every
+# non-table array verbatim (ring, heads/counts, rec fps, spill blocks —
+# all small next to the table) plus ONLY the table slots occupied since
+# the BASE generation was written (cumulative-vs-base, so a single
+# delta + the base reconstructs the newest state and every older delta
+# is disposable). The meta manifest pins the chain to the base's content
+# digest and records per-region occupancy watermarks; the fold validates
+# both, and any failure falls back delta-by-delta to the plain base
+# (then the base's own generation fallback). Rolling compaction: once
+# the chain reaches DELTA_CHAIN_MAX the next save is a fresh full base
+# and the old chain is cleared.
+
+DELTA_CHAIN_MAX = 4
+# Occupancy watermarks are recorded per probe region (equal flat-index
+# stripes of the table): a fold that silently dropped or duplicated
+# rows shows up as a region-count mismatch even when digests agree.
+TABLE_DELTA_REGIONS = 64
+
+
+def delta_chain_paths(path: str) -> list:
+    """On-disk delta chain for base `path`, oldest first
+    (`path.d1`, `path.d2`, ...)."""
+    import os
+
+    out = []
+    g = 1
+    while os.path.exists(f"{path}.d{g}"):
+        out.append(f"{path}.d{g}")
+        g += 1
+    return out
+
+
+def clear_delta_chain(path: str) -> None:
+    """Remove every delta of base `path` (after a compacting full save;
+    a crash in between leaves stale deltas whose base-digest check
+    rejects them on load — safe either way)."""
+    import os
+
+    for dpath in delta_chain_paths(path):
+        try:
+            os.unlink(dpath)
+        except OSError:
+            pass
+
+
+def table_region_occupancy(occ_flat) -> list:
+    """Per-region occupied-slot counts over the flattened table
+    occupancy mask (the delta manifest's insert watermarks)."""
+    import numpy as np
+
+    occ_flat = np.asarray(occ_flat).reshape(-1)
+    n = occ_flat.shape[0]
+    r = min(TABLE_DELTA_REGIONS, max(1, n))
+    edges = (np.arange(r, dtype=np.int64) * n) // r
+    return [int(v) for v in np.add.reduceat(occ_flat.astype(np.int64), edges)]
+
+
+def save_checkpoint_tiered(path: str, meta: dict, arrays: dict, *,
+                           state, tcap: int, keep: int = 1, metrics=None,
+                           chain_max: int = DELTA_CHAIN_MAX):
+    """Save either a full base generation or a delta against the current
+    base, whichever the chain state calls for. ``state`` is the opaque
+    per-engine chain state (``None`` initially and after any resume);
+    returns the new state. A tcap change (growth/reshard rehashed every
+    slot) or a chain at ``chain_max`` forces a compacting full save."""
+    import numpy as np
+
+    occ = (
+        (np.asarray(arrays["table0"]) != 0)
+        | (np.asarray(arrays["table1"]) != 0)
+    ).reshape(-1)
+    if (
+        state is None
+        or state.get("tcap") != tcap
+        or state.get("seq", 0) >= chain_max
+    ):
+        full_meta = save_checkpoint_atomic(
+            path, meta, arrays, keep=keep, metrics=metrics
+        )
+        clear_delta_chain(path)
+        return {
+            "occ": occ,
+            "tcap": int(tcap),
+            "seq": 0,
+            "base_digest": full_meta["digest"],
+        }
+    seq = state["seq"] + 1
+    idx = np.flatnonzero(occ & ~state["occ"])
+    darrays = {
+        k: v for k, v in arrays.items() if not k.startswith("table")
+    }
+    darrays["delta_idx"] = idx.astype(np.int64)
+    for t in range(4):
+        darrays[f"delta_t{t}"] = (
+            np.asarray(arrays[f"table{t}"]).reshape(-1)[idx]
+        )
+    meta = dict(meta)
+    meta["delta"] = {
+        "base_digest": state["base_digest"],
+        "seq": int(seq),
+        "base_tcap": int(tcap),
+        "regions": table_region_occupancy(occ),
+    }
+    save_checkpoint_delta(f"{path}.d{seq}", meta, darrays, metrics=metrics)
+    state = dict(state)
+    state["seq"] = seq
+    return state
+
+
+def save_checkpoint_delta(dpath: str, meta: dict, arrays: dict, *,
+                          metrics=None) -> dict:
+    """Crash-safe write of one delta file (tmp + fsync + rename + dir
+    fsync; no generation rotation — the chain IS the history)."""
+    import os
+
+    t0 = time.monotonic()
+    meta = _write_npz_atomic(dpath, meta, arrays)
+    os.replace(dpath + ".tmp.npz", dpath)
+    _fsync_dir(dpath)
+    if metrics is not None:
+        metrics.inc("checkpoint_delta_saves")
+        metrics.inc("checkpoint_delta_bytes", os.path.getsize(dpath))
+        metrics.inc("checkpoint_delta_rows", int(len(arrays["delta_idx"])))
+        metrics.add_phase("checkpoint_save", time.monotonic() - t0)
+    return meta
+
+
+def _fold_table_delta(base_data: dict, ddata: dict) -> dict:
+    """Newest engine state = the delta's non-table arrays + the base's
+    table lanes with the delta rows scattered in."""
+    import numpy as np
+
+    folded = {
+        k: v for k, v in ddata.items() if not k.startswith("delta_")
+    }
+    idx = np.asarray(ddata["delta_idx"]).reshape(-1)
+    for t in range(4):
+        lane = np.array(base_data[f"table{t}"])  # copy; base stays pristine
+        lane.reshape(-1)[idx] = ddata[f"delta_t{t}"]
+        folded[f"table{t}"] = lane
+    return folded
+
+
+def load_checkpoint_folded(path: str, metrics=None):
+    """Load the newest recoverable engine state: the newest verifiable
+    base generation with the newest verifiable delta (pinned to that
+    base's digest, region watermarks revalidated post-fold) folded on.
+    Falls back delta-by-delta to the plain base; base-generation
+    fallback itself is `load_checkpoint_with_fallback`."""
+    import numpy as np
+
+    base_data, base_meta = load_checkpoint_with_fallback(
+        path, metrics=metrics
+    )
+    base_digest = base_meta.get("digest")
+    for dpath in reversed(delta_chain_paths(path)):
+        try:
+            ddata, dmeta = load_checkpoint_verified(dpath)
+            man = dmeta.get("delta") or {}
+            if man.get("base_digest") != base_digest:
+                # STALE, not corrupt: the base itself fell back a
+                # generation (or the chain outlived a compaction), so a
+                # digest-mismatched delta is the EXPECTED leftover of the
+                # newer base — skip it without the corruption counters
+                # (the base-fallback counter already told that story).
+                if metrics is not None:
+                    metrics.inc("checkpoint_delta_stale")
+                _log.warning(
+                    "delta checkpoint stale for the loaded base; skipped",
+                    path=dpath,
+                )
+                continue
+            folded = _fold_table_delta(base_data, ddata)
+            occ = (
+                (np.asarray(folded["table0"]) != 0)
+                | (np.asarray(folded["table1"]) != 0)
+            )
+            if table_region_occupancy(occ) != list(man.get("regions", [])):
+                raise CheckpointCorruptError(
+                    f"delta checkpoint {dpath!r} fails its per-region "
+                    "insert watermarks after folding"
+                )
+        except CheckpointCorruptError as exc:
+            if metrics is not None:
+                metrics.inc("checkpoint_corrupt_rejected")
+                metrics.inc("checkpoint_fallbacks")
+            _log.warning(
+                "delta checkpoint rejected; falling back",
+                path=dpath,
+                reason=str(exc),
+            )
+            continue
+        if metrics is not None:
+            metrics.inc("checkpoint_delta_folds")
+        return folded, dmeta
+    return base_data, base_meta
 
 
 # -- SIGTERM/SIGINT final-checkpoint flush ------------------------------------

@@ -58,11 +58,12 @@ from ..core import Expectation
 from ..fingerprint import combine64, split64
 from ..path import Path
 from ..tensor import TensorModel, TensorModelAdapter
+from ..ops.tiering import TieredSpillStore, spill_host_budget_bytes
 from .common import (
     HostEngineBase,
-    load_checkpoint_with_fallback,
+    load_checkpoint_folded,
     register_signal_checkpoint_flush,
-    save_checkpoint_atomic,
+    save_checkpoint_tiered,
     validate_checkpoint_cadence,
 )
 
@@ -1477,7 +1478,18 @@ class TpuBfsChecker(HostEngineBase):
         # Host-side bookkeeping.
         self._unique = 0
         self._discovery_fps: Dict[str, int] = {}
-        self._spill: List[np.ndarray] = []
+        # Tiered spill staging (ops/tiering.py): a budgeted host-RAM LIFO
+        # with an npz disk tier below it; unbudgeted (env unset) it is a
+        # plain in-RAM stack, byte-for-byte the old list behavior.
+        self._spill = TieredSpillStore(
+            host_budget_bytes=spill_host_budget_bytes(),
+            on_tier=self._on_spill_tier,
+        )
+        # Delta-checkpoint chain state (engines/common.py
+        # save_checkpoint_tiered): None = next save is a full base.
+        self._ckpt_delta = None
+        # Era of the last proactive reshard (one doubling per forecast).
+        self._reshard_last_era = -1
         # The metrics registry (obs/metrics.py, created by the base class)
         # carries the engine's health gauges — eras dispatched, steps
         # executed, spill/refill row volume, table growths, take_cap —
@@ -1629,7 +1641,10 @@ class TpuBfsChecker(HostEngineBase):
                 and now >= self._deadline - self._timeout / 2
             ):
                 return 1
-            return self._fuse
+            # Auto-N (engines/common.py): when the flight history shows
+            # the dispatch gap already amortized, back the factor off to
+            # keep the wasted-work window on host-intervention exits small.
+            return self._fuse_auto_n(self._fuse)
 
         _dbg("run: encoding inits")
         if self._resume_from is not None:
@@ -1935,7 +1950,7 @@ class TpuBfsChecker(HostEngineBase):
                 params_dev = None  # host-side count changed; force re-upload
                 if self._memory is not None:
                     self._memory.staging(
-                        sum(b.nbytes for b in self._spill),
+                        self._spill.host_bytes(),
                         event="spill",
                         rows=int(k),
                     )
@@ -2054,7 +2069,7 @@ class TpuBfsChecker(HostEngineBase):
             refill = []
             refill_rows = 0
             while self._spill and (
-                count + refill_rows + len(self._spill[-1]) <= spill_target
+                count + refill_rows + self._spill.peek_rows() <= spill_target
                 or (count == 0 and not refill)
             ):
                 refill.append(self._spill.pop())
@@ -2076,7 +2091,7 @@ class TpuBfsChecker(HostEngineBase):
                 host_dirty = True
                 if self._memory is not None:
                     self._memory.staging(
-                        sum(b.nbytes for b in self._spill),
+                        self._spill.host_bytes(),
                         event="refill",
                         rows=int(k),
                     )
@@ -2092,6 +2107,29 @@ class TpuBfsChecker(HostEngineBase):
                 with self._metrics.phase("table_grow"):
                     table, self._tcap = self._grow_table(table)
                 self._metrics.inc("table_growths")
+                host_dirty = True
+                grew = True
+            # Elastic re-shard (ISSUE 20): when the forecaster projects
+            # growth within the horizon, take the doubling NOW at an era
+            # boundary we already own — same rehash as the degraded
+            # regrow, but before any probe-budget abort could trigger it.
+            # Output is untouched: a bigger table changes slots, never
+            # membership (growth rebuilds from the same fingerprints). At
+            # most one proactive doubling per era: the forecast refreshes
+            # at every _flight_record, so each further doubling needs a
+            # projection that already accounts for the last one.
+            if (
+                self._proactive_reshard_due()
+                and self._metrics.get("eras") != self._reshard_last_era
+            ):
+                self._reshard_last_era = self._metrics.get("eras")
+                with self._metrics.phase("table_grow"):
+                    table, self._tcap = self._grow_table(table)
+                self._metrics.inc("table_growths")
+                self._metrics.inc("reshard_proactive")
+                self._obs_event(
+                    "reshard_proactive", table_capacity=self._tcap
+                )
                 host_dirty = True
                 grew = True
             if grew:
@@ -2181,6 +2219,7 @@ class TpuBfsChecker(HostEngineBase):
                         and not self._spill
                         and not self._ckpt_stop.is_set()
                         and not self._timed_out()
+                        and not self._proactive_reshard_due()
                         and (
                             self._ckpt_every is None
                             or time.monotonic() - self._last_ckpt
@@ -2223,6 +2262,7 @@ class TpuBfsChecker(HostEngineBase):
                         and not self._spill
                         and params_dev is not None
                         and self._unique + vcap <= vs.MAX_LOAD * self._tcap
+                        and not self._proactive_reshard_due()
                     ):
                         # The era ended inside every gate: the oldest
                         # chained era IS the next era and has been
@@ -2312,6 +2352,9 @@ class TpuBfsChecker(HostEngineBase):
             self._save_checkpoint(
                 table, queue, head, count, rec_bits, rec_fp1, rec_fp2
             )
+        # Any disk-tier spool is dead weight past this point (a resume
+        # rebuilds the stack from the checkpoint's spill arrays).
+        self._spill.close()
 
         if self._unique < SMALL_WORKLOAD_STATES:
             self._small_workload_hint(self._unique, "explored")
@@ -2346,6 +2389,51 @@ class TpuBfsChecker(HostEngineBase):
                 if self._fuse > 1:
                     led.attach("fusion_tail", params_dev)
         return
+
+    def _on_spill_tier(self, direction, rows, nbytes, disk_bytes) -> None:
+        """Tier-move hook from the TieredSpillStore: counters + the
+        memory ledger's disk component and `spill_tier` event, so
+        `plan == ledger == nbytes` stays exact across all three tiers."""
+        if direction == "ram_to_disk":
+            self._metrics.inc("spill_tier_rows", int(rows))
+        else:
+            self._metrics.inc("spill_tier_refill_rows", int(rows))
+        self._metrics.set_gauge("spill_disk_bytes", int(disk_bytes))
+        if self._memory is not None:
+            self._memory.ledger.register(
+                "spill_disk", nbytes=int(disk_bytes), kind="disk"
+            )
+            self._memory.event(
+                "spill_tier",
+                direction=direction,
+                rows=int(rows),
+                bytes=int(nbytes),
+                disk_bytes=int(disk_bytes),
+            )
+
+    def _proactive_reshard_due(self) -> bool:
+        """Forecast-triggered elastic reshard (ISSUE 20): with a device
+        limit set and exhaustion projected, front-run the next table
+        doubling once the forecaster puts it within the reshard horizon
+        — the growth lands at a host-chosen era boundary (chain drained)
+        instead of the forced mid-pressure one, and never fires on
+        unlimited runs (eras_to_exhaustion needs a limit).  The measured
+        load-fraction floor keeps it self-limiting: each doubling halves
+        ``load_frac``, so a diverging fit cannot re-trigger every era."""
+        rec = self._memory
+        if rec is None:
+            return False
+        fc = rec.last_forecast()
+        if fc.get("eras_to_exhaustion") is None:
+            return False
+        eta_grow = fc.get("eras_to_grow")
+        from ..obs.memory import RESHARD_HORIZON_ERAS, RESHARD_MIN_LOAD_FRAC
+
+        return (
+            eta_grow is not None
+            and eta_grow <= RESHARD_HORIZON_ERAS
+            and fc.get("load_frac", 0.0) >= RESHARD_MIN_LOAD_FRAC
+        )
 
     def _mem_register(self, table, queue, rec_fps, params_dev) -> None:
         """(Re-)register every device buffer with the memory ledger from
@@ -2499,10 +2587,14 @@ class TpuBfsChecker(HostEngineBase):
             arrays[f"table{t}"] = lane
         for w, lane in enumerate(queue):
             arrays[f"queue{w}"] = np.asarray(lane)
-        for i, blk in enumerate(self._spill):
+        for i, blk in enumerate(self._spill.iter_blocks()):
             arrays[f"spill{i}"] = blk
-        save_checkpoint_atomic(
+        # Tiered save (ISSUE 20): a full base when the chain state says so
+        # (first save, tcap changed, chain at max), else a delta holding
+        # only the table rows inserted since the base.
+        self._ckpt_delta = save_checkpoint_tiered(
             self._ckpt_path, meta, arrays,
+            state=self._ckpt_delta, tcap=self._tcap,
             keep=self._ckpt_keep, metrics=self._metrics,
         )
         self._last_ckpt = time.monotonic()
@@ -2515,8 +2607,9 @@ class TpuBfsChecker(HostEngineBase):
         from .common import validate_checkpoint_meta
 
         # Digest-verified load with automatic fallback to the previous
-        # generation when the newest file is truncated/corrupt.
-        data, meta = load_checkpoint_with_fallback(path, metrics=self._metrics)
+        # generation when the newest file is truncated/corrupt, folding any
+        # surviving delta chain onto the base (engines/common.py).
+        data, meta = load_checkpoint_folded(path, metrics=self._metrics)
         validate_checkpoint_meta(
             meta,
             self.tm,
@@ -2544,12 +2637,15 @@ class TpuBfsChecker(HostEngineBase):
             # Restore the sampler's kept set + threshold: a resumed run's
             # sample must be identical to an uninterrupted one.
             self._sampler.restore_state(meta["sampler"])
-        self._spill = [
+        self._spill.reset(
             data[k] for k in sorted(
                 (k for k in data if k.startswith("spill")),
                 key=lambda s: int(s[5:]),
             )
-        ]
+        )
+        # A reload invalidates the delta-chain baseline (the resumed run's
+        # next save must be a fresh full base).
+        self._ckpt_delta = None
         table = vs.pack_lanes(*(data[f"table{t}"] for t in range(4)))
         queue = tuple(jnp.asarray(data[f"queue{w}"]) for w in range(W))
         return (

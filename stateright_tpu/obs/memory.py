@@ -25,8 +25,10 @@ makes the memory axis first-class, in three coupled layers:
     final table size; per-era memory records ride the existing flight
     recorder readback (zero extra device round-trips) and surface as
     ``telemetry()["memory"]``, labeled ``memory_bytes{component=...}``
-    Prometheus gauges, and a one-shot early warning with a concrete
-    recommendation (regrow now / expect spill / use the sharded mesh).
+    Prometheus gauges, and an early warning with a concrete
+    recommendation (regrow now / expect spill / use the sharded mesh)
+    that fires once per approach — it re-arms after every table growth
+    or proactive reshard, so the run warns again at each new wall.
 
 ``plan()``
     Static capacity planning: predict the full device footprint from the
@@ -70,6 +72,16 @@ TABLE_ROW_BYTES = 4 * WORD_BYTES
 #: Early-warning horizon: warn when exhaustion projects within this many
 #: eras (or headroom is already below one further table doubling).
 WARN_HORIZON_ERAS = 32
+#: Proactive-reshard horizon: with a device limit set and exhaustion
+#: projected, the engines front-run a table growth once the next
+#: doubling is forecast within this many eras (the growth lands at a
+#: host-chosen era boundary instead of a forced one — see ISSUE 20).
+RESHARD_HORIZON_ERAS = 8
+#: A proactive reshard additionally requires the table to have consumed
+#: at least this fraction of its growth trigger.  Each doubling halves
+#: the fraction, so the engine stays at most one doubling ahead of real
+#: occupancy instead of chasing a diverging fit era after era.
+RESHARD_MIN_LOAD_FRAC = 0.5
 #: Forecast projection stops once the simulated table passes this many
 #: bytes with no device limit in reach — past an exbibyte the only
 #: information left is "diverging", and doubling further would overflow.
@@ -444,6 +456,11 @@ class MemoryLedger:
         with self._lock:
             return self._total_locked("host")
 
+    def disk_bytes(self) -> int:
+        """Disk-tier spill bytes (npz segments below the host budget)."""
+        with self._lock:
+            return self._total_locked("disk")
+
     def peak_bytes(self) -> int:
         with self._lock:
             return self._peak_bytes
@@ -492,6 +509,7 @@ class MemoryLedger:
                 "components": comps,
                 "total_bytes": self._total_locked("device"),
                 "host_bytes": self._total_locked("host"),
+                "disk_bytes": self._total_locked("disk"),
                 "peak_bytes": self._peak_bytes,
                 "events": [dict(e) for e in self._events],
                 "events_dropped": self._events_dropped,
@@ -568,6 +586,14 @@ class Forecaster:
             "projected_unique": None,
             "projected_table_bytes": None,
             "projected_total_bytes": None,
+            # Fraction of the growth trigger the CURRENT occupancy has
+            # consumed (1.0 == a load-factor growth is due right now).
+            # Measured, not simulated — the reshard gate keys off this.
+            "load_frac": round(
+                (max(0, unique) + reserve_rows)
+                / max(1.0, max_load * max(1, int(rows))),
+                4,
+            ),
         }
         if r is None or d is None:
             return out
@@ -617,8 +643,8 @@ class Forecaster:
 
 
 class MemoryRecorder:
-    """Ledger + forecaster + gauges + one-shot warning, as one object the
-    engines feed at their existing once-per-era readback."""
+    """Ledger + forecaster + gauges + once-per-approach warning, as one
+    object the engines feed at their existing once-per-era readback."""
 
     def __init__(
         self,
@@ -653,11 +679,17 @@ class MemoryRecorder:
     def set_geometry(
         self, *, rows: int, max_load: float, reserve_rows: int
     ) -> None:
+        prev = self._geometry
         self._geometry = {
             "rows": int(rows),
             "max_load": float(max_load),
             "reserve_rows": int(reserve_rows),
         }
+        # A growth/reshard changed the wall the warning was about: re-arm
+        # it so a second approach to the (new) wall warns again instead
+        # of staying silent behind the one-shot latch.
+        if prev is not None and int(rows) > prev["rows"]:
+            self.rearm_warning()
 
     def staging(self, nbytes: int, event: Optional[str] = None, **fields) -> None:
         """Update the host spill-staging component; optionally log the
@@ -672,6 +704,22 @@ class MemoryRecorder:
     @property
     def warning(self) -> Optional[str]:
         return self._warning
+
+    def rearm_warning(self) -> None:
+        """Clear a fired memory-pressure warning so the NEXT approach to
+        the wall warns again (called after growth/reshard events — via
+        ``set_geometry`` — and by the engines' proactive reshard)."""
+        if self._warning is None:
+            return
+        self._warning = None
+        self.ledger.event("memory_warning_rearmed")
+        if self._metrics is not None:
+            self._metrics.set_gauge("memory_warning", 0)
+
+    def last_forecast(self) -> Dict[str, Any]:
+        """The most recent ``on_era`` forecast (empty before the fit has
+        enough observations) — the engines' proactive-reshard trigger."""
+        return dict(self._last_forecast)
 
     # -- the per-era hook ------------------------------------------------
 

@@ -7,4 +7,8 @@ inserts (`visited_set`), and frontier bookkeeping (dedup, compaction, ring
 queue) becomes sort/scan array programs (`frontier`). Everything is uint32
 and jit-compatible so XLA can fuse the whole BFS level into a handful of
 kernels.
+
+One module here is host-side: `tiering` holds the budgeted RAM + npz
+disk store backing the engines' out-of-core frontier spill (the device
+side of spill stays in `frontier`).
 """

@@ -1226,7 +1226,28 @@ class ShardedBfsChecker(HostEngineBase):
 
         self._unique = 0
         self._discovery_fps: Dict[str, int] = {}
-        self._spill: List[List[np.ndarray]] = [[] for _ in range(self.n_shards)]
+        # Tiered spill staging (ops/tiering.py): one budgeted host-RAM
+        # LIFO per shard with an npz disk tier below; the host budget is
+        # split evenly across shards. Unbudgeted (env unset) each store
+        # is a plain in-RAM stack, byte-for-byte the old list behavior.
+        from ..ops.tiering import TieredSpillStore, spill_host_budget_bytes
+
+        _budget = spill_host_budget_bytes()
+        if _budget is not None:
+            _budget = max(1, _budget // self.n_shards)
+        self._spill: List[TieredSpillStore] = [
+            TieredSpillStore(
+                host_budget_bytes=_budget,
+                on_tier=self._on_spill_tier,
+                label=f"spill-s{s}",
+            )
+            for s in range(self.n_shards)
+        ]
+        # Delta-checkpoint chain state (engines/common.py
+        # save_checkpoint_tiered): None = next save is a full base.
+        self._ckpt_delta = None
+        # Era of the last proactive reshard (one doubling per forecast).
+        self._reshard_last_era = -1
         # Sharded checkpoint/resume: per-shard tables, rings, spill lists,
         # take_caps and counters serialize to one .npz at block boundaries
         # (all arrays are host-visible there). Writes are crash-atomic with
@@ -1425,7 +1446,53 @@ class ShardedBfsChecker(HostEngineBase):
 
     def _spill_host_bytes(self) -> int:
         return sum(
-            b.nbytes for s in range(self.n_shards) for b in self._spill[s]
+            self._spill[s].host_bytes() for s in range(self.n_shards)
+        )
+
+    def _on_spill_tier(self, direction, rows, nbytes, disk_bytes) -> None:
+        """Tier-move hook shared by every shard's TieredSpillStore: keep
+        the spill_tier counters, the disk gauge, and the ledger's disk
+        component exact (disk bytes re-register at the ALL-shard total,
+        so plan == ledger == nbytes holds per kind)."""
+        if direction == "ram_to_disk":
+            self._metrics.inc("spill_tier_rows", rows)
+        else:
+            self._metrics.inc("spill_tier_refill_rows", rows)
+        total_disk = sum(
+            self._spill[s].disk_bytes() for s in range(self.n_shards)
+        )
+        self._metrics.set_gauge("spill_disk_bytes", total_disk)
+        if self._memory is not None:
+            led = self._memory.ledger
+            led.register("spill_disk", nbytes=total_disk, kind="disk")
+            led.event(
+                "spill_tier",
+                direction=direction,
+                rows=int(rows),
+                bytes=int(nbytes),
+                disk_bytes=int(total_disk),
+            )
+
+    def _proactive_reshard_due(self) -> bool:
+        """Forecast-triggered elastic reshard (ISSUE 20; mirrors
+        engines/tpu_bfs.py): with a device limit set and exhaustion
+        projected, front-run the next uniform table doubling once the
+        forecaster puts it within the reshard horizon.  The measured
+        load-fraction floor keeps it self-limiting: each doubling halves
+        ``load_frac``, so a diverging fit cannot re-trigger every era."""
+        rec = self._memory
+        if rec is None:
+            return False
+        fc = rec.last_forecast()
+        if fc.get("eras_to_exhaustion") is None:
+            return False
+        eta_grow = fc.get("eras_to_grow")
+        from ..obs.memory import RESHARD_HORIZON_ERAS, RESHARD_MIN_LOAD_FRAC
+
+        return (
+            eta_grow is not None
+            and eta_grow <= RESHARD_HORIZON_ERAS
+            and fc.get("load_frac", 0.0) >= RESHARD_MIN_LOAD_FRAC
         )
 
     def _run_loop(
@@ -1538,7 +1605,9 @@ class ShardedBfsChecker(HostEngineBase):
                 and now >= self._deadline - self._timeout / 2
             ):
                 return 1
-            return self._fuse
+            # Auto-N (engines/common.py): back off when the flight history
+            # shows the dispatch gap already amortized.
+            return self._fuse_auto_n(self._fuse)
 
         def consume(vals, fp1_dev, fp2_dev, dd_dev, era_wall, era_budget,
                     spec_in_flight=False):
@@ -1891,7 +1960,7 @@ class ShardedBfsChecker(HostEngineBase):
                 # 1.5*N*quota (qcap >= 4*N*quota in __init__), so an empty
                 # shard always refills at least one block.
                 while self._spill[s] and (
-                    counts[s] + refill_rows + len(self._spill[s][-1])
+                    counts[s] + refill_rows + self._spill[s].peek_rows()
                     <= spill_target
                 ):
                     refill.append(self._spill[s].pop())
@@ -1933,6 +2002,24 @@ class ShardedBfsChecker(HostEngineBase):
                 with self._metrics.phase("table_grow"):
                     table = self._grow_tables(table)
                 self._metrics.inc("table_growths")
+                grew = True
+            # Elastic re-shard (ISSUE 20; see engines/tpu_bfs.py): when
+            # the forecaster projects growth within the horizon, take the
+            # uniform doubling NOW at this host-owned boundary. At most
+            # one proactive doubling per era — the forecast refreshes at
+            # every _flight_record.
+            if (
+                self._proactive_reshard_due()
+                and self._metrics.get("eras") != self._reshard_last_era
+            ):
+                self._reshard_last_era = self._metrics.get("eras")
+                with self._metrics.phase("table_grow"):
+                    table = self._grow_tables(table)
+                self._metrics.inc("table_growths")
+                self._metrics.inc("reshard_proactive")
+                self._obs_event(
+                    "reshard_proactive", table_capacity_per_shard=self._tcap
+                )
                 grew = True
             if grew:
                 self._mem_register(table, queue, (rec_fp1, rec_fp2), None)
@@ -1997,6 +2084,7 @@ class ShardedBfsChecker(HostEngineBase):
                     and not any(self._spill[s] for s in range(N))
                     and not self._ckpt_stop.is_set()
                     and not self._timed_out()
+                    and not self._proactive_reshard_due()
                     and (
                         self._ckpt_every is None
                         or _time.monotonic() - self._last_ckpt
@@ -2065,6 +2153,7 @@ class ShardedBfsChecker(HostEngineBase):
                     and not any(self._spill[s] for s in range(N))
                     and max(per_shard_unique) + N * self._quota
                     <= vs.MAX_LOAD * self._tcap
+                    and not self._proactive_reshard_due()
                     and (
                         self._sampler is None
                         or self._sampler.threshold_parts() == last_thresh
@@ -2121,6 +2210,10 @@ class ShardedBfsChecker(HostEngineBase):
                 table, queue, heads, counts, rec_bits, rec_fp1, rec_fp2,
                 take_caps, disc_depth_best, per_shard_unique,
             )
+        # Any disk-tier spools are dead weight past this point (a resume
+        # rebuilds the stacks from the checkpoint's spill arrays).
+        for s in range(N):
+            self._spill[s].close()
         # Mega-dispatch gauges: deepest speculative chain reached and the
         # realized fusion ratio (device eras per host dispatch — 1.0 when
         # neither chaining nor fusion engaged).
@@ -2197,7 +2290,7 @@ class ShardedBfsChecker(HostEngineBase):
         equivalent."""
         import time as _time
 
-        from ..engines.common import checkpoint_meta, save_checkpoint_atomic
+        from ..engines.common import checkpoint_meta, save_checkpoint_tiered
         from ..ops import visited_set as vs
 
         meta = checkpoint_meta(
@@ -2241,10 +2334,16 @@ class ShardedBfsChecker(HostEngineBase):
         for w, lane in enumerate(queue):
             arrays[f"queue{w}"] = np.asarray(lane)
         for s in range(self.n_shards):
-            for i, blk in enumerate(self._spill[s]):
+            for i, blk in enumerate(self._spill[s].iter_blocks()):
                 arrays[f"spill_{s}_{i}"] = blk
-        save_checkpoint_atomic(
+        # Tiered save (ISSUE 20): a full base when the chain state says so
+        # (first save, tcap changed, chain at max), else a delta holding
+        # only the table rows inserted since the base — the per-shard
+        # lanes flatten into one occupancy vector, so the shared delta
+        # protocol applies unchanged.
+        self._ckpt_delta = save_checkpoint_tiered(
             self._ckpt_path, meta, arrays,
+            state=self._ckpt_delta, tcap=self._tcap,
             keep=self._ckpt_keep, metrics=self._metrics,
         )
         self._last_ckpt = _time.monotonic()
@@ -2253,14 +2352,15 @@ class ShardedBfsChecker(HostEngineBase):
         import jax.numpy as jnp
 
         from ..engines.common import (
-            load_checkpoint_with_fallback,
+            load_checkpoint_folded,
             validate_checkpoint_meta,
         )
         from ..ops import visited_set as vs
 
         # Digest-verified load with automatic fallback to the previous
-        # generation when the newest file is truncated/corrupt.
-        data, meta = load_checkpoint_with_fallback(path, metrics=self._metrics)
+        # generation when the newest file is truncated/corrupt, folding any
+        # surviving delta chain onto the base (engines/common.py).
+        data, meta = load_checkpoint_folded(path, metrics=self._metrics)
         validate_checkpoint_meta(
             meta,
             self.tm,
@@ -2294,7 +2394,10 @@ class ShardedBfsChecker(HostEngineBase):
                 (k for k in data if k.startswith(f"spill_{s}_")),
                 key=lambda n: int(n.rsplit("_", 1)[1]),
             )
-            self._spill[s] = [data[k] for k in blocks]
+            self._spill[s].reset(data[k] for k in blocks)
+        # A reload invalidates the delta-chain baseline (the resumed run's
+        # next save must be a fresh full base).
+        self._ckpt_delta = None
         table = (
             jnp.asarray(
                 np.concatenate([data["table0"], data["table1"]], axis=1)

@@ -293,6 +293,28 @@ def test_forecaster_plateau():
     assert fc["projected_table_bytes"] == 8 << 20
 
 
+def test_forecaster_load_frac_is_measured_not_simulated():
+    """`load_frac` reports how much of the grow trigger CURRENT occupancy
+    has consumed — the proactive-reshard gate's self-limiting input: a
+    doubling of `rows` halves it regardless of the fitted ratio."""
+    f = Forecaster()
+    for u in (10, 30, 70, 150, 310):
+        f.observe(u)  # diverging fit (r == 2)
+    base = dict(
+        unique=512, max_load=0.25, reserve_rows=0, table_bytes=4096 * 8
+    )
+    fc = f.forecast(rows=4096, **base)
+    assert fc["load_frac"] == pytest.approx(0.5)
+    # Same fit, doubled table: the measured fraction halves even though
+    # the simulated projection still diverges.
+    fc2 = f.forecast(rows=8192, **base)
+    assert fc2["load_frac"] == pytest.approx(0.25)
+    # Reserve rows count against the trigger just like the engines' own
+    # grow check does.
+    fc3 = f.forecast(rows=4096, **{**base, "reserve_rows": 512})
+    assert fc3["load_frac"] == pytest.approx(1.0)
+
+
 def test_forecaster_needs_three_observations():
     f = Forecaster()
     f.observe(10)
